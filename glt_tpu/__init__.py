@@ -1,0 +1,13 @@
+"""glt_tpu — a TPU-native graph-learning framework.
+
+A from-scratch JAX/XLA/Pallas re-design with the capabilities of
+GraphLearn-for-PyTorch (graph sampling, unified feature store, distributed
+sampling/training), built for TPU: static shapes, SPMD meshes, XLA
+collectives, and Pallas kernels on the hot paths.
+"""
+
+__version__ = '0.1.0'
+
+from . import typing  # noqa: F401
+from . import utils  # noqa: F401
+from . import data  # noqa: F401

@@ -1,0 +1,10 @@
+from .topology import Topology
+from .graph import Graph
+from .feature import Feature
+from .dataset import Dataset
+from .reorder import sort_by_in_degree, in_degrees
+
+__all__ = [
+    'Topology', 'Graph', 'Feature', 'Dataset',
+    'sort_by_in_degree', 'in_degrees',
+]

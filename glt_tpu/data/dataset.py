@@ -1,0 +1,230 @@
+"""Dataset: the user-facing container of graph storage + features + labels.
+
+Reference: graphlearn_torch/python/data/dataset.py:30-515. Homogeneous
+payloads are single objects; heterogeneous payloads are dicts keyed by
+NodeType / EdgeType, same convention as the reference's typed getters
+(dataset.py:396-444). Layout rule preserved from dataset.py:110-120:
+edge_dir 'out' -> CSR (indptr over src, sample out-neighbors),
+edge_dir 'in'  -> CSC (indptr over dst, sample in-neighbors).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Union
+
+import numpy as np
+
+from ..typing import EdgeType, GraphMode, NodeType, Split
+from ..utils import as_numpy
+from .feature import Feature
+from .graph import Graph
+from .reorder import sort_by_in_degree
+from .topology import Topology
+
+GraphLike = Union[Graph, Dict[EdgeType, Graph]]
+FeatureLike = Union[Feature, Dict[Union[NodeType, EdgeType], Feature]]
+
+
+class Dataset:
+  def __init__(self,
+               graph: Optional[GraphLike] = None,
+               node_features: Optional[FeatureLike] = None,
+               edge_features: Optional[FeatureLike] = None,
+               node_labels=None,
+               edge_dir: str = 'out',
+               node_split=None):
+    self.graph = graph
+    self.node_features = node_features
+    self.edge_features = edge_features
+    self.node_labels = node_labels
+    assert edge_dir in ('out', 'in')
+    self.edge_dir = edge_dir
+    self.node_split = node_split  # (train_idx, val_idx, test_idx) or dicts
+
+  # -- graph init (reference dataset.py:53-122) --------------------------
+
+  def init_graph(self,
+                 edge_index=None,
+                 edge_ids=None,
+                 edge_weights=None,
+                 num_nodes=None,
+                 layout: str = 'COO',
+                 graph_mode: Union[str, GraphMode] = GraphMode.HBM,
+                 device=None):
+    """``edge_index`` may be an array (homo) or Dict[EdgeType, array]."""
+    target = 'CSR' if self.edge_dir == 'out' else 'CSC'
+
+    def build(ei, eid, ew, n_src, n_dst):
+      # pointer axis of the chosen layout: src for CSR, dst for CSC
+      n_rows, n_cols = (n_src, n_dst) if target == 'CSR' else (n_dst, n_src)
+      if layout.upper() == 'COO':
+        topo = Topology(edge_index=ei, edge_ids=eid, edge_weights=ew,
+                        layout=target, num_rows=n_rows, num_cols=n_cols)
+      else:
+        in_rows, in_cols = ((n_src, n_dst) if layout.upper() == 'CSR'
+                            else (n_dst, n_src))
+        topo = Topology(indptr=ei[0], indices=ei[1], edge_ids=eid,
+                        edge_weights=ew, layout=layout.upper(),
+                        num_rows=in_rows, num_cols=in_cols)
+        if topo.layout != target:
+          topo = topo.flip_layout()
+      return Graph(topo, mode=graph_mode, device=device)
+
+    if isinstance(edge_index, dict):
+      self.graph = {}
+      for etype, ei in edge_index.items():
+        eid = edge_ids.get(etype) if isinstance(edge_ids, dict) else None
+        ew = (edge_weights.get(etype)
+              if isinstance(edge_weights, dict) else None)
+        # num_nodes may be keyed by NodeType (preferred for bipartite
+        # types) or by EdgeType (square), or be a single int.
+        src_t, _, dst_t = etype
+        if isinstance(num_nodes, dict):
+          if src_t in num_nodes or dst_t in num_nodes:
+            n_src = num_nodes.get(src_t)
+            n_dst = num_nodes.get(dst_t)
+          else:
+            n_src = n_dst = num_nodes.get(etype)
+        else:
+          n_src = n_dst = num_nodes
+        self.graph[etype] = build(ei, eid, ew, n_src, n_dst)
+    elif edge_index is not None:
+      self.graph = build(edge_index, edge_ids, edge_weights,
+                         num_nodes, num_nodes)
+    return self
+
+  # -- features (reference dataset.py:236-341) ---------------------------
+
+  def init_node_features(self, node_feature_data=None,
+                         sort_func=None, split_ratio: float = 1.0,
+                         dtype=None, device=None):
+    """``sort_func`` (e.g. sort_by_in_degree) reorders rows so the hot
+    prefix is device-resident; the resulting old->new map is installed as
+    the Feature's id2index so lookups by original id keep working
+    (reference dataset.py:236-298)."""
+    def build(feats, topo):
+      feats = as_numpy(feats)
+      id2index = None
+      if sort_func is not None and topo is not None:
+        feats, id2index = sort_func(feats, split_ratio, topo)
+      return Feature(feats, split_ratio=split_ratio, id2index=id2index,
+                     dtype=dtype, device=device)
+
+    if isinstance(node_feature_data, dict):
+      self.node_features = {}
+      for ntype, feats in node_feature_data.items():
+        topo = self._topo_for_node_type(ntype)
+        self.node_features[ntype] = build(feats, topo)
+    elif node_feature_data is not None:
+      topo = self.graph.topo if isinstance(self.graph, Graph) else None
+      self.node_features = build(node_feature_data, topo)
+    return self
+
+  def init_edge_features(self, edge_feature_data=None, dtype=None,
+                         device=None):
+    if isinstance(edge_feature_data, dict):
+      self.edge_features = {
+          etype: Feature(f, dtype=dtype, device=device)
+          for etype, f in edge_feature_data.items()}
+    elif edge_feature_data is not None:
+      self.edge_features = Feature(edge_feature_data, dtype=dtype,
+                                   device=device)
+    return self
+
+  def init_node_labels(self, node_label_data=None):
+    if isinstance(node_label_data, dict):
+      self.node_labels = {k: as_numpy(v) for k, v in node_label_data.items()}
+    elif node_label_data is not None:
+      self.node_labels = as_numpy(node_label_data)
+    return self
+
+  # -- splits (reference dataset.py:124-153) -----------------------------
+
+  def random_node_split(self, num_val, num_test, seed: int = 0):
+    def split_one(n):
+      rng = np.random.default_rng(seed)
+      perm = rng.permutation(n)
+      nv = int(num_val * n) if isinstance(num_val, float) else num_val
+      nt = int(num_test * n) if isinstance(num_test, float) else num_test
+      return (perm[nv + nt:], perm[:nv], perm[nv:nv + nt])
+
+    if isinstance(self.graph, dict):
+      self.node_split = {
+          nt: split_one(self.node_count(nt)) for nt in self.get_node_types()}
+    else:
+      self.node_split = split_one(self.graph.num_nodes)
+    return self
+
+  def get_split(self, split: Split, ntype: Optional[NodeType] = None):
+    s = self.node_split
+    if isinstance(s, dict) and ntype is not None:
+      s = s[ntype]
+    idx = {Split.train: 0, Split.valid: 1, Split.test: 2}[Split(split)]
+    return s[idx]
+
+  # -- typed getters (reference dataset.py:396-444) ----------------------
+
+  @property
+  def is_hetero(self) -> bool:
+    return isinstance(self.graph, dict)
+
+  def get_graph(self, etype: Optional[EdgeType] = None) -> Graph:
+    if isinstance(self.graph, dict):
+      return self.graph[etype]
+    return self.graph
+
+  def get_node_feature(self, ntype: Optional[NodeType] = None) -> Feature:
+    if isinstance(self.node_features, dict):
+      return self.node_features[ntype]
+    return self.node_features
+
+  def get_edge_feature(self, etype: Optional[EdgeType] = None) -> Feature:
+    if isinstance(self.edge_features, dict):
+      return self.edge_features[etype]
+    return self.edge_features
+
+  def get_node_label(self, ntype: Optional[NodeType] = None):
+    if isinstance(self.node_labels, dict):
+      return self.node_labels[ntype]
+    return self.node_labels
+
+  def get_node_types(self):
+    if not self.is_hetero:
+      return None
+    out = []
+    for (src, _, dst) in self.graph.keys():
+      for t in (src, dst):
+        if t not in out:
+          out.append(t)
+    return out
+
+  def get_edge_types(self):
+    if not self.is_hetero:
+      return None
+    return list(self.graph.keys())
+
+  def node_count(self, ntype: Optional[NodeType] = None) -> int:
+    if not self.is_hetero:
+      return self.graph.num_nodes
+    best = 0
+    for (src, _, dst), g in self.graph.items():
+      # CSR rows are src, CSC rows are dst; indices are the other endpoint
+      row_t = src if g.layout == 'CSR' else dst
+      col_t = dst if g.layout == 'CSR' else src
+      if row_t == ntype:
+        best = max(best, g.topo.num_rows)
+      if col_t == ntype:
+        best = max(best, g.topo.num_cols)
+    if isinstance(self.node_features, dict) and ntype in self.node_features:
+      best = max(best, self.node_features[ntype].num_rows)
+    return best
+
+  # -- internals ---------------------------------------------------------
+
+  def _topo_for_node_type(self, ntype: NodeType):
+    if not isinstance(self.graph, dict):
+      return None
+    for (src, _, dst), g in self.graph.items():
+      row_t = src if g.layout == 'CSR' else dst
+      if row_t == ntype:
+        return g.topo
+    return None

@@ -1,0 +1,151 @@
+"""Feature store with a device hot-cache and host spill.
+
+Reference: graphlearn_torch/python/data/feature.py:32-283 and the native
+UnifiedTensor (csrc/cuda/unified_tensor.cu). The reference splits rows by
+``split_ratio`` into a GPU part (replicated per NVLink DeviceGroup) and a
+pinned-CPU zero-copy part read over UVA inside GatherTensorKernel
+(unified_tensor.cu:35-81). TPU-native translation:
+
+  * hot rows  -> one jax array in HBM, gathered in-jit (``jnp.take``; the
+    XLA gather runs at HBM bandwidth which is exactly what the warp-per-row
+    GatherTensorKernel achieves on GPU);
+  * cold rows -> numpy in host RAM; gathered on host and ``device_put`` per
+    batch (the PCIe/UVA analogue). The loader overlaps this host stage with
+    device compute, which replaces the reference's zero-copy latency hiding.
+
+DeviceGroup/NVLink replication (feature.py:179-199) and CUDA-IPC sharing
+(feature.py:209-261) have no TPU equivalent: under SPMD one sharded global
+array is addressable from every chip, and the distributed feature store
+(glt_tpu.distributed.dist_feature) shards rows over the mesh instead.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import as_numpy
+
+
+class Feature:
+  """2-D feature table split into [hot | cold] rows.
+
+  Rows [0, hot_count) live on device, rows [hot_count, N) on host. Callers
+  that reorder rows by hotness first (see :func:`glt_tpu.data.reorder.
+  sort_by_in_degree`) get the reference's cache behavior: frequently
+  sampled nodes resolve entirely in HBM.
+
+  Args:
+    feats: [N, D] array-like.
+    split_ratio: fraction of rows resident on device (reference semantics,
+      feature.py:101-140). 1.0 = fully device-resident (DMA mode), 0.0 =
+      fully host (pure zero-copy mode).
+    id2index: optional dense global-id -> row map applied before lookup
+      (reference feature.py:142-155).
+    dtype: optional cast (e.g. jnp.bfloat16 for fp16-style compression,
+      examples/igbh compress path).
+  """
+
+  def __init__(self, feats, split_ratio: float = 1.0,
+               id2index: Optional[np.ndarray] = None,
+               device: Optional[jax.Device] = None,
+               dtype=None):
+    feats = as_numpy(feats)
+    if feats.ndim == 1:
+      feats = feats[:, None]
+    self._host_full = feats
+    self.split_ratio = float(split_ratio)
+    self.hot_count = int(round(feats.shape[0] * self.split_ratio))
+    self.device = device
+    self.dtype = dtype if dtype is not None else feats.dtype
+    self._id2index = as_numpy(id2index)
+    self._id2index_dev = None
+    self._hot = None
+    self._cold = None
+    self._initialized = False
+
+  # -- lazy split/placement (reference lazy-init pattern, feature.py:29) --
+
+  def lazy_init(self) -> None:
+    if self._initialized:
+      return
+    n_hot = self.hot_count
+    hot_np = self._host_full[:n_hot]
+    self._hot = jax.device_put(
+        jnp.asarray(hot_np, dtype=self.dtype), self.device)
+    self._cold = self._host_full[n_hot:]
+    if self._id2index is not None:
+      self._id2index_dev = jax.device_put(
+          jnp.asarray(self._id2index), self.device)
+    self._host_full = None  # single-copy invariant, as in the reference
+    self._initialized = True
+
+  # -- geometry ----------------------------------------------------------
+
+  @property
+  def shape(self):
+    if self._initialized:
+      return (self._hot.shape[0] + self._cold.shape[0], self._hot.shape[1])
+    return self._host_full.shape
+
+  @property
+  def num_rows(self) -> int:
+    return self.shape[0]
+
+  @property
+  def feature_dim(self) -> int:
+    return self.shape[1]
+
+  @property
+  def fully_device_resident(self) -> bool:
+    return self.hot_count >= self.num_rows
+
+  @property
+  def device_part(self) -> jax.Array:
+    self.lazy_init()
+    return self._hot
+
+  @property
+  def id2index(self):
+    self.lazy_init()
+    return self._id2index_dev
+
+  # -- lookup ------------------------------------------------------------
+
+  def map_ids(self, ids):
+    if self._id2index is None:
+      return ids
+    if isinstance(ids, np.ndarray):
+      return self._id2index[ids]
+    self.lazy_init()
+    return jnp.take(self._id2index_dev, ids, mode='clip')
+
+  def device_gather(self, rows: jax.Array) -> jax.Array:
+    """Jit-safe gather; only valid when fully device resident (hot==all).
+    ``rows`` are post-id2index row indices."""
+    self.lazy_init()
+    return jnp.take(self._hot, rows, axis=0, mode='clip')
+
+  def gather_cold_host(self, rows: np.ndarray) -> np.ndarray:
+    """Host gather of cold rows (rows are absolute; caller pre-filters
+    rows >= hot_count). The UVA-read analogue."""
+    self.lazy_init()
+    return np.asarray(
+        self._cold[rows - self.hot_count], dtype=self.dtype)
+
+  def __getitem__(self, ids) -> np.ndarray:
+    """Host-side convenience lookup returning numpy (reference cpu_get,
+    feature.py:157-164)."""
+    self.lazy_init()
+    ids = as_numpy(ids).astype(np.int64)
+    rows = self.map_ids(ids)
+    out = np.empty((rows.shape[0], self.feature_dim), dtype=self.dtype)
+    hot_mask = rows < self.hot_count
+    if hot_mask.any():
+      out[hot_mask] = np.asarray(
+          jnp.take(self._hot, jnp.asarray(rows[hot_mask]), axis=0))
+    if (~hot_mask).any():
+      out[~hot_mask] = self.gather_cold_host(rows[~hot_mask])
+    return out

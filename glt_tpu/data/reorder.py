@@ -1,0 +1,55 @@
+"""Degree-based feature reordering for hot-cache locality.
+
+Reference: graphlearn_torch/python/data/reorder.py:19-36
+(``sort_by_in_degree``): sort feature rows by descending in-degree so the hot
+prefix lands in the device cache; returns (reordered features, old->new map).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..utils import as_numpy
+from .topology import Topology
+
+
+def in_degrees(topo: Topology) -> np.ndarray:
+  if topo.layout == 'CSC':
+    return np.asarray(topo.degrees)
+  deg = np.bincount(as_numpy(topo.indices).astype(np.int64),
+                    minlength=topo.num_cols)
+  return deg
+
+
+def sort_by_in_degree(
+    feats: np.ndarray,
+    split_ratio: float,
+    topo: Topology,
+    shuffle_ratio: float = 0.0,
+    rng: Optional[np.random.Generator] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+  """Returns (reordered_feats, old2new) with hottest rows first.
+
+  ``split_ratio`` is part of the sort-func calling convention used by
+  ``Dataset.init_node_features`` (the reference passes it so sort funcs can
+  tailor ordering to the cache size, data/dataset.py:236-298); the pure
+  degree sort does not need it. ``shuffle_ratio`` randomly swaps a fraction
+  of assignments, matching the reference's optional perturbation.
+  """
+  feats = as_numpy(feats)
+  deg = in_degrees(topo)
+  n = feats.shape[0]
+  if deg.shape[0] < n:
+    deg = np.concatenate([deg, np.zeros(n - deg.shape[0], dtype=deg.dtype)])
+  order = np.argsort(-deg[:n], kind='stable')  # new row k holds old node order[k]
+  if shuffle_ratio > 0.0:
+    rng = rng or np.random.default_rng(0)
+    k = int(n * shuffle_ratio)
+    if k > 1:
+      pick = rng.choice(n, size=k, replace=False)
+      shuffled = rng.permutation(pick)
+      order[pick] = order[shuffled]
+  old2new = np.empty(n, dtype=np.int64)
+  old2new[order] = np.arange(n, dtype=np.int64)
+  return feats[order], old2new

@@ -1,0 +1,87 @@
+"""Core type aliases and shared enums/structs for glt_tpu.
+
+TPU-native re-design of the reference type layer
+(reference: graphlearn_torch/python/typing.py:25-93). We keep the same
+node/edge-type conventions (so hetero graphs, edge-type reversal and
+partition data structures behave identically) but all tensor payloads are
+numpy / jax arrays instead of torch tensors.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Dict, List, NamedTuple, Optional, Tuple, Union
+
+import numpy as np
+
+# -- Hetero typing (reference typing.py:25-46) --------------------------------
+
+NodeType = str
+#: (src_node_type, relation, dst_node_type)
+EdgeType = Tuple[str, str, str]
+
+_REV_PREFIX = 'rev_'
+
+
+def as_str(type_: Union[NodeType, EdgeType]) -> str:
+  if isinstance(type_, NodeType):
+    return type_
+  if isinstance(type_, (list, tuple)) and len(type_) == 3:
+    return '__'.join(type_)
+  return ''
+
+
+def reverse_edge_type(etype: EdgeType) -> EdgeType:
+  """'rev_' naming convention for reversed relations."""
+  src, rel, dst = etype
+  if src != dst:
+    if rel.startswith(_REV_PREFIX):
+      rel = rel[len(_REV_PREFIX):]
+    else:
+      rel = _REV_PREFIX + rel
+  return (dst, rel, src)
+
+
+# -- Splits (reference typing.py:55-58) ---------------------------------------
+
+class Split(enum.Enum):
+  train = 'train'
+  valid = 'valid'
+  test = 'test'
+
+
+# -- Graph residency mode ------------------------------------------------------
+# The reference has CPU / DMA(copy-to-GPU) / ZERO_COPY(pinned-UVA)
+# (include/graph.h:25-28).  On TPU the analogous residencies are:
+#   HBM  -- topology lives as jax device arrays in TPU HBM (DMA analogue)
+#   HOST -- topology stays in host memory as numpy; device code receives
+#           gathered slices on demand (ZERO_COPY / UVA analogue).
+
+class GraphMode(enum.Enum):
+  HBM = 'HBM'
+  HOST = 'HOST'
+
+
+# -- Partition payloads (reference typing.py:62-82) ---------------------------
+
+class GraphPartitionData(NamedTuple):
+  """Edges assigned to one partition. ``edge_index``: [2, E] (row, col)."""
+  edge_index: np.ndarray
+  eids: np.ndarray
+  weights: Optional[np.ndarray] = None
+
+
+class FeaturePartitionData(NamedTuple):
+  """Features of one partition: owned rows plus the hot-cache rows."""
+  feats: Optional[np.ndarray]
+  ids: Optional[np.ndarray]
+  cache_feats: Optional[np.ndarray]
+  cache_ids: Optional[np.ndarray]
+
+
+HeteroNodeSeedDict = Dict[NodeType, np.ndarray]
+HeteroEdgeSeedDict = Dict[EdgeType, np.ndarray]
+
+NumNeighbors = Union[List[int], Dict[EdgeType, List[int]]]
+
+InputNodes = Union[np.ndarray, Tuple[NodeType, np.ndarray]]
+InputEdges = Union[np.ndarray, Tuple[EdgeType, np.ndarray]]

@@ -1,0 +1,11 @@
+from .tensor import (
+    as_numpy, as_jax, id2idx, ensure_device, index_select,
+)
+from .common import seed_everything, merge_dict, parse_size
+from .rng import RandomSeedManager, new_key
+
+__all__ = [
+    'as_numpy', 'as_jax', 'id2idx', 'ensure_device', 'index_select',
+    'seed_everything', 'merge_dict', 'parse_size',
+    'RandomSeedManager', 'new_key',
+]

@@ -1,0 +1,49 @@
+"""Misc helpers (reference: graphlearn_torch/python/utils/common.py, units.py)."""
+from __future__ import annotations
+
+import random
+from typing import Dict, List
+
+import numpy as np
+
+
+def seed_everything(seed: int) -> None:
+  """Seed python/numpy and the glt_tpu RandomSeedManager
+  (reference utils/common.py:31-41)."""
+  random.seed(seed)
+  np.random.seed(seed)
+  from .rng import RandomSeedManager
+  RandomSeedManager.getInstance().setSeed(seed)
+
+
+def merge_dict(in_dict: Dict, out_dict: Dict) -> Dict:
+  """Append values of ``in_dict`` onto value-lists of ``out_dict``
+  (reference utils/common.py:85-97)."""
+  for k, v in in_dict.items():
+    vals = out_dict.get(k, [])
+    vals.append(v)
+    out_dict[k] = vals
+  return out_dict
+
+
+_UNITS = {
+    'k': 1024, 'm': 1024 ** 2, 'g': 1024 ** 3, 't': 1024 ** 4,
+    'kb': 1024, 'mb': 1024 ** 2, 'gb': 1024 ** 3, 'tb': 1024 ** 4,
+}
+
+
+def parse_size(size: object) -> int:
+  """'10GB' -> bytes (reference utils/units.py)."""
+  if isinstance(size, (int, np.integer)):
+    return int(size)
+  s = str(size).strip().lower()
+  num = s
+  unit = ''
+  for i, ch in enumerate(s):
+    if not (ch.isdigit() or ch == '.'):
+      num, unit = s[:i], s[i:].strip()
+      break
+  if unit and unit not in _UNITS:
+    raise ValueError(f'unknown size unit {unit!r}')
+  scale = _UNITS.get(unit, 1)
+  return int(float(num) * scale)

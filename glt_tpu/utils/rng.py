@@ -1,0 +1,48 @@
+"""Seed management.
+
+TPU-native analogue of the reference's native ``RandomSeedManager`` singleton
+(reference include/common.h:36-61, used by neighbor_sampler.py:67-68): a
+process-wide base seed from which functional jax PRNG keys are derived.
+Every consumer folds in a fresh counter so independent samplers never share
+a key stream, while the whole run stays reproducible from one seed.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+
+class RandomSeedManager:
+  _instance = None
+  _lock = threading.Lock()
+
+  def __init__(self):
+    self._seed = 42
+    self._counter = 0
+    self._local = threading.Lock()
+
+  @classmethod
+  def getInstance(cls) -> 'RandomSeedManager':
+    with cls._lock:
+      if cls._instance is None:
+        cls._instance = cls()
+      return cls._instance
+
+  def setSeed(self, seed: int) -> None:
+    with self._local:
+      self._seed = int(seed)
+      self._counter = 0
+
+  def getSeed(self) -> int:
+    return self._seed
+
+  def nextKey(self) -> jax.Array:
+    with self._local:
+      c = self._counter
+      self._counter += 1
+    return jax.random.fold_in(jax.random.key(self._seed), c)
+
+
+def new_key() -> jax.Array:
+  return RandomSeedManager.getInstance().nextKey()

@@ -1,0 +1,71 @@
+"""Array conversion helpers (reference: graphlearn_torch/python/utils/tensor.py).
+
+The reference converts arbitrary nested inputs to torch tensors and builds
+dense id->index maps (tensor.py:30-97). Here the host-side currency is numpy
+and the device-side currency is jax arrays.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def as_numpy(x: Any, dtype=None) -> Optional[np.ndarray]:
+  """Convert array-likes (lists, jax arrays, torch tensors) to numpy."""
+  if x is None:
+    return None
+  if isinstance(x, dict):
+    return {k: as_numpy(v, dtype) for k, v in x.items()}
+  if isinstance(x, np.ndarray):
+    arr = x
+  elif isinstance(x, jax.Array):
+    arr = np.asarray(x)
+  elif hasattr(x, 'detach'):  # torch tensor without importing torch
+    arr = x.detach().cpu().numpy()
+  else:
+    arr = np.asarray(x)
+  if dtype is not None:
+    arr = arr.astype(dtype, copy=False)
+  return arr
+
+
+def as_jax(x: Any, dtype=None) -> Optional[jax.Array]:
+  if x is None:
+    return None
+  if isinstance(x, dict):
+    return {k: as_jax(v, dtype) for k, v in x.items()}
+  arr = jnp.asarray(as_numpy(x))
+  if dtype is not None:
+    arr = arr.astype(dtype)
+  return arr
+
+
+def ensure_device(x: Any, device=None) -> Any:
+  """device_put pytree leaves (host->HBM transfer point)."""
+  if device is None:
+    return jax.device_put(x)
+  return jax.device_put(x, device)
+
+
+def id2idx(ids: np.ndarray) -> np.ndarray:
+  """Dense global-id -> local-index map (reference utils/tensor.py:30-39).
+
+  Returns an array of size max(ids)+1 where out[ids[i]] = i.
+  """
+  ids = as_numpy(ids).astype(np.int64)
+  max_id = int(ids.max()) if ids.size else 0
+  out = np.zeros(max_id + 1, dtype=np.int64)
+  out[ids] = np.arange(ids.shape[0], dtype=np.int64)
+  return out
+
+
+def index_select(data: Any, index: np.ndarray) -> Any:
+  """Row-select over arrays / dicts of arrays."""
+  if data is None:
+    return None
+  if isinstance(data, dict):
+    return {k: index_select(v, index) for k, v in data.items()}
+  return data[index]

@@ -1,0 +1,23 @@
+"""Test harness config: run every test on an 8-device virtual CPU mesh.
+
+Mirrors the reference's strategy of testing the real distributed stack on a
+single host (SURVEY.md §4): instead of torch.multiprocessing.spawn over
+localhost rpc, we ask XLA for 8 host devices so sharding/collective code
+paths execute exactly as they would on a TPU slice.
+"""
+import os
+
+# Must run before jax initializes its backend.
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+_flags = os.environ.get('XLA_FLAGS', '')
+if 'xla_force_host_platform_device_count' not in _flags:
+  os.environ['XLA_FLAGS'] = (
+      _flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+  return np.random.default_rng(0)

@@ -1,0 +1,66 @@
+"""Regression tests for bipartite (rectangular) topologies — the hetero
+('user','u2i','item')-style edge types that the RGCN/RGAT configs rely on."""
+import numpy as np
+
+from glt_tpu.data import Dataset, Topology
+from glt_tpu.typing import Split
+
+
+def test_bipartite_flip_layout():
+  # 3 users -> 10 items
+  ei = np.array([[0, 1, 2], [9, 5, 7]])
+  csr = Topology(edge_index=ei, layout='CSR', num_rows=3, num_cols=10)
+  assert csr.num_rows == 3 and csr.num_cols == 10
+  csc = csr.flip_layout()
+  assert csc.layout == 'CSC'
+  assert csc.num_rows == 10 and csc.num_cols == 3
+  np.testing.assert_array_equal(csc.degrees,
+                                [0, 0, 0, 0, 0, 1, 0, 1, 0, 1])
+  back = csc.flip_layout()
+  np.testing.assert_array_equal(back.indptr, csr.indptr)
+  np.testing.assert_array_equal(back.indices, csr.indices)
+
+
+def test_bipartite_csc_build():
+  ei = np.array([[0, 1, 2], [9, 5, 7]])
+  csc = Topology(edge_index=ei, layout='CSC', num_rows=10, num_cols=3)
+  assert csc.indptr.shape[0] == 11
+  np.testing.assert_array_equal(csc.indices[csc.indptr[9]:csc.indptr[10]], [0])
+
+
+def test_row_out_of_range_raises():
+  ei = np.array([[0, 5], [1, 1]])
+  try:
+    Topology(edge_index=ei, layout='CSR', num_rows=3, num_cols=2)
+    raise AssertionError('expected ValueError')
+  except ValueError as ex:
+    assert 'out of range' in str(ex)
+
+
+def test_bipartite_dataset_split_covers_dst_type():
+  u2i = ('user', 'u2i', 'item')
+  ds = Dataset(edge_dir='out')
+  ds.init_graph(edge_index={u2i: np.array([[0, 1, 2], [9, 5, 7]])},
+                num_nodes={'user': 3, 'item': 10})
+  assert ds.node_count('item') == 10
+  assert ds.node_count('user') == 3
+  ds.random_node_split(num_val=0.2, num_test=0.2)
+  tr, va, te = ds.node_split['item']
+  all_ids = np.sort(np.concatenate([tr, va, te]))
+  np.testing.assert_array_equal(all_ids, np.arange(10))
+
+
+def test_indptr_is_int64_on_host():
+  ei = np.array([[0, 1], [1, 0]])
+  topo = Topology(edge_index=ei, num_nodes=2)
+  assert topo.indptr.dtype == np.int64
+
+
+def test_dataset_edge_dir_in_bipartite():
+  u2i = ('user', 'u2i', 'item')
+  ds = Dataset(edge_dir='in')
+  ds.init_graph(edge_index={u2i: np.array([[0, 1, 2], [9, 5, 7]])},
+                num_nodes={'user': 3, 'item': 10})
+  g = ds.get_graph(u2i)
+  assert g.layout == 'CSC'
+  assert g.topo.num_rows == 10 and g.topo.num_cols == 3

@@ -1,0 +1,52 @@
+import numpy as np
+import pytest
+
+from glt_tpu.data import Feature, Topology, sort_by_in_degree
+
+
+def test_fully_device_resident_lookup():
+  feats = np.arange(20, dtype=np.float32).reshape(10, 2)
+  f = Feature(feats, split_ratio=1.0)
+  out = f[np.array([3, 0, 9])]
+  np.testing.assert_allclose(out, feats[[3, 0, 9]])
+  assert f.fully_device_resident
+
+
+def test_split_lookup_crosses_hot_cold_boundary():
+  feats = np.arange(40, dtype=np.float32).reshape(10, 4)
+  f = Feature(feats, split_ratio=0.3)  # rows 0-2 hot, 3-9 cold
+  assert f.hot_count == 3
+  ids = np.array([0, 5, 2, 9, 3])
+  np.testing.assert_allclose(f[ids], feats[ids])
+  assert not f.fully_device_resident
+
+
+def test_id2index_mapping():
+  feats = np.array([[10.], [20.], [30.]], dtype=np.float32)
+  id2index = np.array([2, 0, 1])  # global id 0 -> row 2, etc.
+  f = Feature(feats, id2index=id2index)
+  out = f[np.array([0, 1, 2])]
+  np.testing.assert_allclose(out, [[30.], [10.], [20.]])
+
+
+def test_sort_by_in_degree_reorder():
+  # node 2 has in-degree 3, node 0 has 1, node 1 has 0
+  ei = np.array([[0, 1, 0, 2], [2, 2, 2, 0]])
+  topo = Topology(edge_index=ei, num_nodes=3)
+  feats = np.array([[0.], [1.], [2.]], dtype=np.float32)
+  sorted_feats, old2new = sort_by_in_degree(feats, 0.5, topo)
+  # hottest first: node2, then node0, then node1
+  np.testing.assert_allclose(sorted_feats, [[2.], [0.], [1.]])
+  np.testing.assert_array_equal(old2new, [1, 2, 0])
+  # lookup through the map returns original values
+  f = Feature(sorted_feats, split_ratio=1.0, id2index=old2new)
+  np.testing.assert_allclose(f[np.array([0, 1, 2])], feats)
+
+
+def test_dtype_cast_bf16():
+  import jax.numpy as jnp
+  feats = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+  f = Feature(feats, split_ratio=0.5, dtype=jnp.bfloat16)
+  out = f[np.arange(8)]
+  np.testing.assert_allclose(
+      out.astype(np.float32), feats, rtol=2e-2, atol=2e-2)

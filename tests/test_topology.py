@@ -1,0 +1,90 @@
+import numpy as np
+import pytest
+
+from glt_tpu.data import Topology
+from glt_tpu.typing import GraphMode
+from glt_tpu.data import Graph
+
+
+def test_coo_to_csr_basic():
+  # 4 nodes: 0->1, 0->2, 1->2, 3->0  (given shuffled)
+  ei = np.array([[1, 3, 0, 0], [2, 0, 2, 1]])
+  topo = Topology(edge_index=ei, layout='CSR', num_nodes=4)
+  assert topo.layout == 'CSR'
+  np.testing.assert_array_equal(topo.indptr, [0, 2, 3, 3, 4])
+  np.testing.assert_array_equal(topo.indices, [1, 2, 2, 0])
+  # edge_ids map compressed slots back to original COO positions
+  np.testing.assert_array_equal(topo.edge_ids, [3, 2, 0, 1])
+  np.testing.assert_array_equal(topo.degrees, [2, 1, 0, 1])
+
+
+def test_coo_to_csc():
+  ei = np.array([[1, 3, 0, 0], [2, 0, 2, 1]])
+  topo = Topology(edge_index=ei, layout='CSC', num_nodes=4)
+  # in-edges: node0 <- 3; node1 <- 0; node2 <- 0, 1
+  np.testing.assert_array_equal(topo.indptr, [0, 1, 2, 4, 4])
+  np.testing.assert_array_equal(topo.indices, [3, 0, 0, 1])
+
+
+def test_columns_sorted_within_rows():
+  rng = np.random.default_rng(0)
+  n, e = 50, 400
+  ei = rng.integers(0, n, size=(2, e))
+  topo = Topology(edge_index=ei, num_nodes=n)
+  for v in range(n):
+    seg = topo.indices[topo.indptr[v]:topo.indptr[v + 1]]
+    assert np.all(np.diff(seg) >= 0)
+
+
+def test_edge_ids_and_weights_follow_permutation():
+  ei = np.array([[2, 0, 1], [0, 1, 0]])
+  eids = np.array([10, 11, 12])
+  w = np.array([0.5, 0.25, 0.125], dtype=np.float32)
+  topo = Topology(edge_index=ei, edge_ids=eids, edge_weights=w, num_nodes=3)
+  # CSR order: (0->1, id 11, w .25), (1->0, id 12, w .125), (2->0, id 10, w .5)
+  np.testing.assert_array_equal(topo.edge_ids, [11, 12, 10])
+  np.testing.assert_allclose(topo.edge_weights, [0.25, 0.125, 0.5])
+
+
+def test_flip_layout_roundtrip():
+  rng = np.random.default_rng(1)
+  ei = rng.integers(0, 30, size=(2, 200))
+  csr = Topology(edge_index=ei, layout='CSR', num_nodes=30)
+  csc = csr.flip_layout()
+  assert csc.layout == 'CSC'
+  back = csc.flip_layout()
+  np.testing.assert_array_equal(back.indptr, csr.indptr)
+  np.testing.assert_array_equal(back.indices, csr.indices)
+  np.testing.assert_array_equal(back.edge_ids, csr.edge_ids)
+  # edge set is identical: (src,dst,eid) triples match
+  src_a, dst_a, id_a = csr.to_coo()
+  dst_b, src_b, id_b = csc.to_coo()
+  tri_a = sorted(zip(src_a.tolist(), dst_a.tolist(), id_a.tolist()))
+  tri_b = sorted(zip(src_b.tolist(), dst_b.tolist(), id_b.tolist()))
+  assert tri_a == tri_b
+
+
+def test_csr_input_passthrough():
+  indptr = np.array([0, 2, 3, 3])
+  indices = np.array([2, 1, 0])
+  topo = Topology(indptr=indptr, indices=indices, layout='CSR')
+  np.testing.assert_array_equal(topo.indptr, indptr)
+  # columns get sorted within rows
+  np.testing.assert_array_equal(topo.indices, [1, 2, 0])
+  np.testing.assert_array_equal(topo.edge_ids, [1, 0, 2])
+
+
+def test_graph_device_arrays():
+  ei = np.array([[0, 1], [1, 0]])
+  topo = Topology(edge_index=ei, num_nodes=2)
+  g = Graph(topo, mode=GraphMode.HBM)
+  assert g.num_nodes == 2 and g.num_edges == 2
+  np.testing.assert_array_equal(np.asarray(g.indptr), [0, 1, 2])
+  np.testing.assert_array_equal(g.degree(np.array([0, 1])), [1, 1])
+
+
+def test_isolated_node_padding():
+  ei = np.array([[0], [1]])
+  topo = Topology(edge_index=ei, num_nodes=5)
+  assert topo.indptr.shape[0] == 6
+  np.testing.assert_array_equal(topo.degrees, [1, 0, 0, 0, 0])
