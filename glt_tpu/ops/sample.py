@@ -1,0 +1,190 @@
+"""Neighbor sampling primitives — static-shape, XLA-friendly.
+
+TPU-native equivalent of the reference's fused CUDA sampling kernels
+(csrc/cuda/random_sampler.cu:36-165, csrc/cpu/random_sampler.cc,
+csrc/cpu/weighted_sampler.cc). Design differences, per SURVEY.md §7:
+
+* The reference allocates exact-size outputs after a device prefix-scan
+  (random_sampler.cu:284-301). XLA wants static shapes, so every seed gets
+  exactly ``fanout`` output slots plus a validity mask; ``nbrs_num``
+  becomes ``mask.sum(-1)``.
+* The reference's warp-per-row reservoir sampling with atomicMax ordering
+  (random_sampler.cu:59-109) is replaced by **Floyd's algorithm**: K
+  rounds of (draw, collision->swap-in-boundary) per seed. Same
+  uniform-without-replacement distribution, no atomics, fully vectorized
+  over the seed batch on the VPU; K is static and small so the loop
+  unrolls into straight-line vector code.
+* Weighted sampling (CPU-only upstream, weighted_sampler.cc:26-79) is done
+  device-side via Gumbel-top-k over a degree-capped neighbor window —
+  weight-proportional sampling *without replacement* in one vectorized
+  top_k.
+
+All functions are jit-safe and shard_map-safe (pure gathers + elementwise).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class NeighborOutput(NamedTuple):
+  """One-hop sampling result (reference sampler/base.py NeighborOutput),
+  in padded layout: every field is [S, K]."""
+  nbrs: jax.Array        # neighbor node ids, undefined where ~mask
+  mask: jax.Array        # bool validity
+  eids: jax.Array        # edge ids (compressed-slot or original), if requested
+
+  @property
+  def nbrs_num(self) -> jax.Array:
+    return self.mask.sum(axis=-1)
+
+
+def _floyd_offsets(deg: jax.Array, u: jax.Array, fanout: int) -> jax.Array:
+  """Floyd's uniform sampling of `fanout` distinct offsets from [0, deg).
+
+  Valid only where deg >= fanout (caller selects). u: [fanout, S] uniforms.
+  """
+  s = deg.shape[0]
+  chosen = jnp.zeros((s, fanout), jnp.int32)
+  for j in range(fanout):
+    bound = deg - fanout + j           # draw from [0, bound] inclusive
+    bound = jnp.maximum(bound, 0)
+    t = jnp.minimum((u[j] * (bound + 1).astype(u.dtype)).astype(jnp.int32),
+                    bound)
+    if j > 0:
+      dup = jnp.any(chosen[:, :j] == t[:, None], axis=1)
+    else:
+      dup = jnp.zeros((s,), bool)
+    pick = jnp.where(dup, bound, t)
+    chosen = chosen.at[:, j].set(pick)
+  return chosen
+
+
+def sample_neighbors(
+    indptr: jax.Array,
+    indices: jax.Array,
+    seeds: jax.Array,
+    fanout: int,
+    key: jax.Array,
+    seed_mask: Optional[jax.Array] = None,
+    edge_ids: Optional[jax.Array] = None,
+    replace: bool = False,
+) -> NeighborOutput:
+  """Uniformly sample up to ``fanout`` neighbors per seed from a CSR/CSC.
+
+  fanout == -1 is not supported here (full neighborhood is the subgraph
+  op's job); fanout must be a static positive int.
+
+  Returns padded [S, fanout] neighbors + mask; when a seed's degree is
+  <= fanout the sample is exhaustive and in adjacency order (which makes
+  tiny-graph tests exact, the reference test strategy SURVEY.md §4).
+  """
+  assert fanout > 0, 'fanout must be a static positive int'
+  seeds = seeds.astype(indptr.dtype)
+  num_edges = indices.shape[0]
+  start = jnp.take(indptr, seeds, mode='clip')
+  end = jnp.take(indptr, seeds + 1, mode='clip')
+  deg = (end - start).astype(jnp.int32)
+  if seed_mask is not None:
+    deg = jnp.where(seed_mask, deg, 0)
+
+  iota = jnp.arange(fanout, dtype=jnp.int32)[None, :]    # [1, K]
+  if replace:
+    u = jax.random.uniform(key, (seeds.shape[0], fanout))
+    offsets = jnp.minimum((u * deg[:, None]).astype(jnp.int32),
+                          jnp.maximum(deg[:, None] - 1, 0))
+    mask = jnp.broadcast_to(deg[:, None] > 0, offsets.shape)
+  else:
+    u = jax.random.uniform(key, (fanout, seeds.shape[0]))
+    sampled = _floyd_offsets(deg, u, fanout)
+    exhaustive = jnp.broadcast_to(iota, sampled.shape)
+    offsets = jnp.where((deg <= fanout)[:, None], exhaustive, sampled)
+    mask = iota < jnp.minimum(deg, fanout)[:, None]
+
+  slots = jnp.clip(start[:, None] + offsets.astype(start.dtype),
+                   0, max(num_edges - 1, 0))
+  nbrs = jnp.take(indices, slots, mode='clip')
+  eids = jnp.take(edge_ids, slots, mode='clip') if edge_ids is not None \
+      else slots
+  return NeighborOutput(nbrs=nbrs, mask=mask, eids=eids)
+
+
+def sample_neighbors_weighted(
+    indptr: jax.Array,
+    indices: jax.Array,
+    weights: jax.Array,
+    seeds: jax.Array,
+    fanout: int,
+    key: jax.Array,
+    max_degree: int,
+    seed_mask: Optional[jax.Array] = None,
+    edge_ids: Optional[jax.Array] = None,
+) -> NeighborOutput:
+  """Weight-proportional sampling without replacement via Gumbel-top-k.
+
+  The neighbor window per seed is capped at ``max_degree`` (static): for
+  hub nodes with more neighbors only the first ``max_degree`` (in
+  adjacency order) participate. Pass ``max_degree >= topo.max_degree``
+  for exact semantics.
+  """
+  assert fanout > 0
+  assert fanout <= max_degree, (
+      f'fanout ({fanout}) must be <= max_degree ({max_degree}); raise '
+      'max_degree to at least the fanout')
+  seeds = seeds.astype(indptr.dtype)
+  num_edges = indices.shape[0]
+  start = jnp.take(indptr, seeds, mode='clip')
+  end = jnp.take(indptr, seeds + 1, mode='clip')
+  deg = (end - start).astype(jnp.int32)
+  if seed_mask is not None:
+    deg = jnp.where(seed_mask, deg, 0)
+  deg = jnp.minimum(deg, max_degree)
+
+  win = jnp.arange(max_degree, dtype=jnp.int32)[None, :]  # [1, D]
+  valid = win < deg[:, None]                               # [S, D]
+  slots = jnp.clip(start[:, None] + win.astype(start.dtype),
+                   0, max(num_edges - 1, 0))
+  w = jnp.take(weights, slots, mode='clip').astype(jnp.float32)
+  w = jnp.where(valid & (w > 0), w, 0.0)
+  g = -jnp.log(-jnp.log(
+      jax.random.uniform(key, w.shape, minval=1e-20, maxval=1.0)))
+  keys = jnp.where(w > 0, jnp.log(w) + g, -jnp.inf)
+  _, top = jax.lax.top_k(keys, fanout)                    # [S, K] window idx
+  top_valid = jnp.take_along_axis(keys, top, axis=1) > -jnp.inf
+  off = top.astype(start.dtype)
+  pick = jnp.clip(start[:, None] + off, 0, max(num_edges - 1, 0))
+  nbrs = jnp.take(indices, pick, mode='clip')
+  eids = jnp.take(edge_ids, pick, mode='clip') if edge_ids is not None \
+      else pick
+  return NeighborOutput(nbrs=nbrs, mask=top_valid, eids=eids)
+
+
+def neighbor_probs(
+    indptr: jax.Array,
+    indices: jax.Array,
+    seed_probs: jax.Array,
+    fanout: int,
+    num_nodes: int,
+) -> jax.Array:
+  """Hotness propagation for FrequencyPartitioner — the CalNbrProbKernel
+  equivalent (random_sampler.cu:167-209): given per-node access
+  probabilities, push one hop of expected sampling probability to
+  neighbors: p_nbr += p(src) * min(fanout, deg)/deg spread per neighbor.
+
+  Edge-parallel formulation: for each edge (u -> v),
+  contribution(v) = p(u) * min(fanout/deg(u), 1).
+  """
+  deg = (indptr[1:] - indptr[:-1]).astype(jnp.float32)
+  rate = jnp.where(deg > 0, jnp.minimum(fanout / jnp.maximum(deg, 1.0), 1.0),
+                   0.0)
+  contrib_per_src = seed_probs * rate                     # [N]
+  # expand to edges: edge e has src = row(e)
+  rows = jnp.searchsorted(indptr, jnp.arange(indices.shape[0],
+                                             dtype=indptr.dtype),
+                          side='right') - 1
+  contrib = jnp.take(contrib_per_src, rows)
+  out = jnp.zeros((num_nodes,), jnp.float32)
+  out = out.at[indices].add(contrib)
+  return jnp.minimum(out, 1.0)

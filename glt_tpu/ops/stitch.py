@@ -1,0 +1,37 @@
+"""Stitching per-partition partial sample results back into seed order.
+
+Reference: csrc/cuda/stitch_sample_results.cu:27-108 (scatter nbrs_num by
+partial index lists, cumsum, copy each partial run to its global offset).
+In the padded TPU layout stitching is a pure positional scatter: each
+partition returns results for the seed *positions* it served, so merging
+is ``out[idx_p] = part_p`` with no prefix scan at all — the reason the
+reference needs one (variable-length runs) disappears with static [S, K]
+blocks. Used by the SPMD distributed sampler after all_to_all returns.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def stitch_rows(idx_list: Sequence[jax.Array],
+                parts: Sequence[jax.Array],
+                total: int) -> jax.Array:
+  """Scatter row-blocks to their global positions.
+
+  Args:
+    idx_list: per-partition [m_p] original positions (may be padded with
+      -1, those rows are dropped).
+    parts: per-partition [m_p, ...] row blocks.
+    total: number of output rows.
+  """
+  first = parts[0]
+  # one sacrificial row at index `total` absorbs padded (-1) positions so a
+  # pad can never collide with a real row-0 write
+  out = jnp.zeros((total + 1,) + first.shape[1:], first.dtype)
+  for idx, part in zip(idx_list, parts):
+    safe = jnp.where(idx >= 0, idx, total)
+    out = out.at[safe].set(part)
+  return out[:total]

@@ -1,0 +1,93 @@
+"""Induced-subgraph extraction over a node set.
+
+Reference: csrc/cuda/subgraph_op.cu (hash-insert nodes, count edges whose
+dst is in the set with a warp reduce, prefix-scan, emit relabeled COO).
+TPU formulation: the node set is deduped with :func:`ordered_unique`; each
+node's neighbor window (capped at ``max_degree``) is gathered, membership
+of the endpoint in the set is a fixed-depth binary search over the *sorted*
+unique node list, and the relabeled COO comes out padded [U, max_degree]
+with a mask — compaction happens only if the caller asks for it.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .unique import ordered_unique
+
+
+class SubGraph(NamedTuple):
+  """Reference py_export_glt.cc:77-82 SubGraph{nodes,rows,cols,eids}, in
+  padded layout."""
+  nodes: jax.Array       # [U_cap] unique input nodes, -1 padded
+  node_count: jax.Array  # scalar
+  rows: jax.Array        # [U_cap * D] relabeled src
+  cols: jax.Array        # [U_cap * D] relabeled dst
+  eids: jax.Array        # [U_cap * D]
+  edge_mask: jax.Array   # [U_cap * D]
+
+
+def _searchsorted_in_set(sorted_set: jax.Array, set_count: jax.Array,
+                         queries: jax.Array):
+  """Position of each query in the ascending ``sorted_set`` (padded with
+  int-max); returns (pos, found)."""
+  pos = jnp.searchsorted(sorted_set, queries)
+  cap = sorted_set.shape[0]
+  at = jnp.take(sorted_set, jnp.clip(pos, 0, cap - 1), mode='clip')
+  found = (pos < set_count) & (at == queries)
+  return pos, found
+
+
+def induced_subgraph(
+    indptr: jax.Array,
+    indices: jax.Array,
+    srcs: jax.Array,
+    src_mask: jax.Array,
+    node_capacity: int,
+    max_degree: int,
+    edge_ids: Optional[jax.Array] = None,
+    with_edge: bool = True,
+) -> SubGraph:
+  """NodeSubGraph(srcs, with_edge) equivalent (subgraph_op.cu:34-117).
+
+  Labels follow first-occurrence order of ``srcs`` (matching the
+  reference's inducer-based relabeling). ``max_degree`` must bound the
+  degree of every node in the set for exact extraction.
+  """
+  uniq, count, _ = ordered_unique(srcs, src_mask, node_capacity)
+  node_valid = jnp.arange(node_capacity) < count
+
+  # membership structure: sort unique ids ascending (-1 pads -> int max)
+  big = jnp.iinfo(uniq.dtype).max
+  masked = jnp.where(node_valid, uniq, big)
+  sort_order = jnp.argsort(masked)
+  sorted_ids = jnp.take(masked, sort_order)
+  # label of sorted_ids[k] is sort_order[k] (position in appearance order)
+
+  num_edges = indices.shape[0]
+  start = jnp.take(indptr, jnp.clip(uniq, 0, None), mode='clip')
+  win = jnp.arange(max_degree, dtype=jnp.int32)[None, :]
+  deg = (jnp.take(indptr, jnp.clip(uniq, 0, None) + 1, mode='clip')
+         - start).astype(jnp.int32)
+  deg = jnp.where(node_valid, deg, 0)
+  slot_valid = win < deg[:, None]                       # [U, D]
+  slots = jnp.clip(start[:, None] + win.astype(start.dtype),
+                   0, max(num_edges - 1, 0))
+  nbr = jnp.take(indices, slots, mode='clip')           # [U, D] global ids
+  pos, found = _searchsorted_in_set(sorted_ids, count, nbr.reshape(-1))
+  nbr_label = jnp.take(sort_order, jnp.clip(pos, 0, node_capacity - 1),
+                       mode='clip').astype(jnp.int32)
+  edge_mask = slot_valid.reshape(-1) & found
+  rows = jnp.repeat(jnp.arange(node_capacity, dtype=jnp.int32), max_degree)
+  cols = jnp.where(edge_mask, nbr_label.reshape(-1), -1)
+  rows = jnp.where(edge_mask, rows, -1)
+  if with_edge:
+    eids = (jnp.take(edge_ids, slots, mode='clip') if edge_ids is not None
+            else slots).reshape(-1)
+    eids = jnp.where(edge_mask, eids, -1)
+  else:
+    eids = jnp.full((node_capacity * max_degree,), -1, jnp.int32)
+  return SubGraph(nodes=uniq, node_count=count, rows=rows, cols=cols,
+                  eids=eids, edge_mask=edge_mask)

@@ -1,0 +1,112 @@
+"""Ordered dedup/relabel — the inducer's hash table, the TPU way.
+
+The reference dedups frontier nodes with an open-addressing GPU hash table
+(include/hash_table.cuh:27-84, atomicCAS insert + atomicMin first-occurrence
+ordering) inside CUDAInducer (csrc/cuda/inducer.cu:33-133). TPUs have no
+device atomics in that style, so we get identical semantics from sorts
+(SURVEY.md §7 "Hard parts"): stable-sort by value, mark run heads, then
+order runs by their first-occurrence position. All shapes static.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def ordered_unique(
+    ids: jax.Array,
+    valid: jax.Array,
+    capacity: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+  """First-occurrence-ordered unique with inverse labels, static shapes.
+
+  Args:
+    ids: [M] integer ids.
+    valid: [M] bool; invalid slots are ignored.
+    capacity: static output size; must be >= the number of distinct valid
+      ids (callers size it with the same Σ batch·Πfanouts bound the
+      reference uses, neighbor_sampler.py:660-677).
+
+  Returns:
+    uniq: [capacity] distinct ids in order of first appearance, -1 padded.
+    count: scalar int32 number of distinct ids.
+    inverse: [M] int32, inverse[i] = position of ids[i] in uniq
+      (first-occurrence order); -1 where ~valid.
+  """
+  m = ids.shape[0]
+  big = jnp.iinfo(ids.dtype).max
+  x = jnp.where(valid, ids, big)
+  order = jnp.argsort(x, stable=True)                 # [M] value-sorted
+  xs = jnp.take(x, order)
+  head = jnp.concatenate(
+      [jnp.ones((1,), bool), xs[1:] != xs[:-1]]) & (xs != big)
+  # run index (value order) per sorted element; invalid tail inherits the
+  # last run id but is masked out of `inverse` below.
+  seg = jnp.cumsum(head) - 1                          # [M]
+  # run heads carry the min original position (stable sort guarantees it)
+  run_starts = jnp.nonzero(head, size=capacity, fill_value=m)[0]
+  run_ok = run_starts < m
+  safe = jnp.minimum(run_starts, m - 1)
+  run_first_pos = jnp.where(run_ok, jnp.take(order, safe), m)
+  run_vals = jnp.where(run_ok, jnp.take(xs, safe), big)
+  # appearance order = ascending first position
+  aorder = jnp.argsort(run_first_pos)
+  uniq = jnp.take(run_vals, aorder)
+  count = head.sum().astype(jnp.int32)
+  # rank of each value-ordered run in appearance order
+  rank = jnp.zeros((capacity,), jnp.int32).at[aorder].set(
+      jnp.arange(capacity, dtype=jnp.int32))
+  seg_at_orig = jnp.zeros((m,), jnp.int32).at[order].set(
+      seg.astype(jnp.int32))
+  inverse = jnp.take(rank, jnp.clip(seg_at_orig, 0, capacity - 1))
+  inverse = jnp.where(valid, inverse, -1)
+  uniq = jnp.where(jnp.arange(capacity) < count, uniq, -1)
+  return uniq, count, inverse
+
+
+class InducerState(NamedTuple):
+  """Functional equivalent of the stateful CUDA/CPU Inducer
+  (include/inducer_base.h:28-48): the growing list of unique nodes whose
+  positions are the compact relabeled indices."""
+  nodes: jax.Array   # [capacity] global ids, -1 padded
+  count: jax.Array   # scalar int32
+
+
+def init_node(seeds: jax.Array, seed_mask: jax.Array,
+              capacity: int) -> Tuple[InducerState, jax.Array]:
+  """Dedup seeds and open the node list (InducerBase::InitNode).
+
+  Returns (state, seed_labels [S]) where seed_labels are each seed's
+  compact index (-1 for masked seeds).
+  """
+  uniq, count, inv = ordered_unique(seeds, seed_mask, capacity)
+  return InducerState(nodes=uniq, count=count), inv
+
+
+def induce_next(
+    state: InducerState,
+    src_labels: jax.Array,   # [F] compact labels of the frontier
+    nbrs: jax.Array,         # [F, K] sampled neighbor global ids
+    nbr_mask: jax.Array,     # [F, K]
+) -> Tuple[InducerState, jax.Array, jax.Array, jax.Array]:
+  """Merge sampled neighbors into the node list (InducerBase::InduceNext).
+
+  Returns (new_state, rows, cols, edge_mask):
+    rows: [F*K] parent compact labels (src repeated per slot)
+    cols: [F*K] child compact labels
+    edge_mask: [F*K]
+  Existing nodes keep their labels: the previous unique list is prepended
+  before dedup, so its entries are the first occurrences by construction.
+  """
+  capacity = state.nodes.shape[0]
+  f, k = nbrs.shape
+  prev_valid = jnp.arange(capacity) < state.count
+  cat_ids = jnp.concatenate([state.nodes, nbrs.reshape(-1)])
+  cat_valid = jnp.concatenate([prev_valid, nbr_mask.reshape(-1)])
+  uniq, count, inv = ordered_unique(cat_ids, cat_valid, capacity)
+  cols = inv[capacity:]
+  rows = jnp.repeat(src_labels, k)
+  edge_mask = nbr_mask.reshape(-1) & (rows >= 0)
+  return (InducerState(nodes=uniq, count=count), rows, cols, edge_mask)

@@ -7,15 +7,26 @@ paths execute exactly as they would on a TPU slice.
 """
 import os
 
-# Must run before jax initializes its backend.
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# Must run before jax initializes its backend. NOTE: the JAX_PLATFORMS env
+# var is overridden by the axon TPU plugin in this image — the config API
+# is authoritative, so force CPU through it.
 _flags = os.environ.get('XLA_FLAGS', '')
 if 'xla_force_host_platform_device_count' not in _flags:
   os.environ['XLA_FLAGS'] = (
       _flags + ' --xla_force_host_platform_device_count=8').strip()
 
+import jax
+
+jax.config.update('jax_platforms', 'cpu')
+
 import numpy as np
 import pytest
+
+
+def pytest_configure(config):
+  assert jax.devices()[0].platform == 'cpu', (
+      'tests must run on the virtual CPU mesh, not the real TPU')
+  assert jax.device_count() == 8
 
 
 @pytest.fixture

@@ -1,0 +1,99 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from glt_tpu.data import Topology
+from glt_tpu.ops import (
+    edge_in_csr, random_negative_sample, induced_subgraph,
+)
+
+
+def _dense_edges(topo):
+  s = set()
+  for v in range(topo.num_rows):
+    for c in topo.indices[topo.indptr[v]:topo.indptr[v + 1]]:
+      s.add((v, int(c)))
+  return s
+
+
+def test_edge_in_csr_exact():
+  rng = np.random.default_rng(0)
+  n = 30
+  ei = rng.integers(0, n, size=(2, 150))
+  t = Topology(edge_index=ei, num_nodes=n)
+  edges = _dense_edges(t)
+  qr = rng.integers(0, n, size=400)
+  qc = rng.integers(0, n, size=400)
+  got = np.asarray(edge_in_csr(jnp.asarray(t.indptr), jnp.asarray(t.indices),
+                               jnp.asarray(qr), jnp.asarray(qc)))
+  expect = np.array([(int(r), int(c)) in edges for r, c in zip(qr, qc)])
+  np.testing.assert_array_equal(got, expect)
+
+
+def test_negative_sampling_strict_excludes_edges():
+  rng = np.random.default_rng(1)
+  n = 20
+  ei = rng.integers(0, n, size=(2, 120))
+  t = Topology(edge_index=ei, num_nodes=n)
+  edges = _dense_edges(t)
+  out = random_negative_sample(
+      jnp.asarray(t.indptr), jnp.asarray(t.indices),
+      req_num=64, trials_num=5, key=jax.random.key(0),
+      num_rows=n, num_cols=n, strict=True, padding=False)
+  rows, cols, mask = (np.asarray(out.rows), np.asarray(out.cols),
+                      np.asarray(out.mask))
+  assert mask.sum() > 0
+  for r, c in zip(rows[mask], cols[mask]):
+    assert (int(r), int(c)) not in edges
+
+
+def test_negative_sampling_padding_fills_all():
+  # complete digraph on 3 nodes -> no strict negatives exist (incl self?)
+  n = 3
+  rows, cols = np.meshgrid(np.arange(n), np.arange(n), indexing='ij')
+  ei = np.stack([rows.reshape(-1), cols.reshape(-1)])
+  t = Topology(edge_index=ei, num_nodes=n)
+  out = random_negative_sample(
+      jnp.asarray(t.indptr), jnp.asarray(t.indices),
+      req_num=16, trials_num=3, key=jax.random.key(0),
+      num_rows=n, num_cols=n, strict=True, padding=True)
+  assert np.asarray(out.mask).all()
+  strict_out = random_negative_sample(
+      jnp.asarray(t.indptr), jnp.asarray(t.indices),
+      req_num=16, trials_num=3, key=jax.random.key(0),
+      num_rows=n, num_cols=n, strict=True, padding=False)
+  assert not np.asarray(strict_out.mask).any()
+
+
+def test_induced_subgraph_exact():
+  # 0->1,0->2,1->2,2->3,3->0 ; induce on {0,1,2}
+  ei = np.array([[0, 0, 1, 2, 3], [1, 2, 2, 3, 0]])
+  t = Topology(edge_index=ei, num_nodes=4)
+  sub = induced_subgraph(
+      jnp.asarray(t.indptr), jnp.asarray(t.indices),
+      jnp.array([0, 1, 2, 0]), jnp.ones(4, bool),
+      node_capacity=6, max_degree=4, edge_ids=jnp.asarray(t.edge_ids))
+  assert int(sub.node_count) == 3
+  np.testing.assert_array_equal(np.asarray(sub.nodes)[:3], [0, 1, 2])
+  em = np.asarray(sub.edge_mask)
+  rows = np.asarray(sub.rows)[em]
+  cols = np.asarray(sub.cols)[em]
+  eids = np.asarray(sub.eids)[em]
+  got = sorted(zip(rows.tolist(), cols.tolist(), eids.tolist()))
+  # edges inside {0,1,2}: 0->1 (eid0), 0->2 (eid1), 1->2 (eid2)
+  assert got == [(0, 1, 0), (0, 2, 1), (1, 2, 2)]
+
+
+def test_induced_subgraph_label_order_follows_first_occurrence():
+  ei = np.array([[5, 9], [9, 5]])
+  t = Topology(edge_index=ei, num_nodes=10)
+  sub = induced_subgraph(
+      jnp.asarray(t.indptr), jnp.asarray(t.indices),
+      jnp.array([9, 5, 9]), jnp.ones(3, bool),
+      node_capacity=4, max_degree=2)
+  np.testing.assert_array_equal(np.asarray(sub.nodes)[:2], [9, 5])
+  em = np.asarray(sub.edge_mask)
+  pairs = sorted(zip(np.asarray(sub.rows)[em].tolist(),
+                     np.asarray(sub.cols)[em].tolist()))
+  # 9->5 is (label0 -> label1), 5->9 is (label1 -> label0)
+  assert pairs == [(0, 1), (1, 0)]

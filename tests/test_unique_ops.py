@@ -1,0 +1,100 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from glt_tpu.ops import ordered_unique, init_node, induce_next
+
+
+def test_ordered_unique_first_occurrence():
+  ids = jnp.array([7, 3, 7, 9, 3, 1])
+  valid = jnp.ones(6, bool)
+  uniq, count, inv = ordered_unique(ids, valid, capacity=8)
+  assert int(count) == 4
+  np.testing.assert_array_equal(np.asarray(uniq),
+                                [7, 3, 9, 1, -1, -1, -1, -1])
+  np.testing.assert_array_equal(np.asarray(inv), [0, 1, 0, 2, 1, 3])
+
+
+def test_ordered_unique_with_invalid():
+  ids = jnp.array([5, 5, 2, 8, 2])
+  valid = jnp.array([True, False, True, False, True])
+  uniq, count, inv = ordered_unique(ids, valid, capacity=4)
+  assert int(count) == 2
+  np.testing.assert_array_equal(np.asarray(uniq)[:2], [5, 2])
+  np.testing.assert_array_equal(np.asarray(inv), [0, -1, 1, -1, 1])
+
+
+def test_ordered_unique_all_invalid():
+  ids = jnp.array([1, 2, 3])
+  valid = jnp.zeros(3, bool)
+  uniq, count, inv = ordered_unique(ids, valid, capacity=4)
+  assert int(count) == 0
+  assert np.all(np.asarray(uniq) == -1)
+  assert np.all(np.asarray(inv) == -1)
+
+
+def test_ordered_unique_jit_and_big_random():
+  rng = np.random.default_rng(0)
+  ids = rng.integers(0, 50, size=257)
+  fn = jax.jit(lambda x: ordered_unique(x, jnp.ones(257, bool), 257))
+  uniq, count, inv = fn(jnp.asarray(ids))
+  # numpy reference: first-occurrence order
+  _, first_idx = np.unique(ids, return_index=True)
+  expect = ids[np.sort(first_idx)]
+  assert int(count) == len(expect)
+  np.testing.assert_array_equal(np.asarray(uniq)[:len(expect)], expect)
+  # inverse maps back to original values
+  np.testing.assert_array_equal(np.asarray(uniq)[np.asarray(inv)], ids)
+
+
+def test_inducer_init_and_induce():
+  # seeds [10, 20, 10] -> labels [0, 1, 0]
+  seeds = jnp.array([10, 20, 10])
+  state, labels = init_node(seeds, jnp.ones(3, bool), capacity=16)
+  np.testing.assert_array_equal(np.asarray(labels), [0, 1, 0])
+  assert int(state.count) == 2
+
+  # frontier = [10, 20] (labels 0, 1); nbrs: 10->{20,30}, 20->{30,40}
+  nbrs = jnp.array([[20, 30], [30, 40]])
+  mask = jnp.ones((2, 2), bool)
+  state2, rows, cols, emask = induce_next(
+      state, jnp.array([0, 1]), nbrs, mask)
+  assert int(state2.count) == 4
+  np.testing.assert_array_equal(np.asarray(state2.nodes)[:4],
+                                [10, 20, 30, 40])
+  np.testing.assert_array_equal(np.asarray(rows), [0, 0, 1, 1])
+  np.testing.assert_array_equal(np.asarray(cols), [1, 2, 2, 3])
+  assert np.asarray(emask).all()
+
+
+def test_inducer_label_stability_across_hops():
+  # previously-seen nodes keep labels when re-encountered in later hops
+  state, _ = init_node(jnp.array([5]), jnp.ones(1, bool), capacity=8)
+  state, _, cols1, _ = induce_next(
+      state, jnp.array([0]), jnp.array([[6, 7]]), jnp.ones((1, 2), bool))
+  # hop 2 from node 6 (label 1) back to 5 and to new node 8
+  state, rows2, cols2, _ = induce_next(
+      state, jnp.array([1]), jnp.array([[5, 8]]), jnp.ones((1, 2), bool))
+  np.testing.assert_array_equal(np.asarray(cols2), [0, 3])  # 5 kept label 0
+  np.testing.assert_array_equal(np.asarray(state.nodes)[:4], [5, 6, 7, 8])
+
+
+def test_inducer_masked_neighbors_ignored():
+  state, _ = init_node(jnp.array([1, 2]), jnp.ones(2, bool), capacity=8)
+  nbrs = jnp.array([[3, 99], [4, 98]])
+  mask = jnp.array([[True, False], [True, False]])
+  state2, rows, cols, emask = induce_next(
+      state, jnp.array([0, 1]), nbrs, mask)
+  assert int(state2.count) == 4
+  np.testing.assert_array_equal(np.asarray(state2.nodes)[:4], [1, 2, 3, 4])
+  np.testing.assert_array_equal(np.asarray(emask), [True, False, True, False])
+
+
+def test_stitch_rows_pad_does_not_clobber_row_zero():
+  from glt_tpu.ops import stitch_rows
+  # partition A serves positions [0, -1(pad)]; B serves [1]
+  out = stitch_rows(
+      [jnp.array([0, -1]), jnp.array([1])],
+      [jnp.array([[42.], [99.]]), jnp.array([[7.]])],
+      total=2)
+  np.testing.assert_allclose(np.asarray(out), [[42.], [7.]])
