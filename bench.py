@@ -1,0 +1,89 @@
+"""Headline benchmark: neighbor-sampling + induction throughput per chip.
+
+Protocol mirrors the reference's benchmarks/api/bench_sampler.py
+("Sampled Edges per secs: {} M" over ogbn-products, batch 1024, fanout
+[15,10,5]): here on a synthetic products-scale graph (2.45M nodes, ~62M
+directed edges) generated in-process since datasets are not downloadable
+in this environment. The measured quantity is identical: valid sampled
+edges per second of wall-clock, steady state, one chip.
+
+``vs_baseline`` compares against an A100 running the reference's CUDA
+sampler on the same protocol. Upstream commits no number (BASELINE.md);
+we use 2.0e8 edges/s as the assumed A100 figure (order-of-magnitude from
+the reference's scale_up figure) until a measured value is available.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+A100_ASSUMED_EDGES_PER_SEC = 2.0e8
+
+NUM_NODES = 2_450_000
+NUM_EDGES = 62_000_000
+BATCH = 1024
+FANOUT = (15, 10, 5)
+WARMUP = 3
+ITERS = 30
+
+
+def main():
+  import jax
+  import jax.numpy as jnp
+  from glt_tpu.data import Topology
+  from glt_tpu.ops.pipeline import multihop_sample
+  from glt_tpu.ops.sample import sample_neighbors
+  from glt_tpu.ops.unique import dense_make_tables
+
+  rng = np.random.default_rng(0)
+  # power-law-ish out-degrees like products: most nodes ~25, some hubs
+  src = rng.integers(0, NUM_NODES, NUM_EDGES, dtype=np.int64)
+  dst = rng.integers(0, NUM_NODES, NUM_EDGES, dtype=np.int64)
+  topo = Topology(indptr=None, edge_index=np.stack([src, dst]),
+                  num_nodes=NUM_NODES)
+  del src, dst
+  indptr = jnp.asarray(topo.indptr.astype(np.int32))
+  indices = jnp.asarray(topo.indices)
+
+  one_hop = lambda ids, fanout, key, mask: sample_neighbors(
+      indptr, indices, ids, fanout, key, seed_mask=mask)
+
+  @jax.jit
+  def sample_batch(seeds, key, table, scratch):
+    out, table, scratch = multihop_sample(
+        one_hop, seeds, jnp.asarray(BATCH), FANOUT, key, table, scratch)
+    return out['num_sampled_edges'].sum(), table, scratch
+
+  table, scratch = dense_make_tables(NUM_NODES)
+  seed_pool = rng.integers(0, NUM_NODES, (ITERS + WARMUP, BATCH))
+  keys = jax.random.split(jax.random.key(0), ITERS + WARMUP)
+
+  edges = None
+  for i in range(WARMUP):
+    edges, table, scratch = sample_batch(
+        jnp.asarray(seed_pool[i], jnp.int32), keys[i], table, scratch)
+  jax.block_until_ready(edges)
+
+  total_edges = 0
+  t0 = time.time()
+  for i in range(WARMUP, WARMUP + ITERS):
+    edges, table, scratch = sample_batch(
+        jnp.asarray(seed_pool[i], jnp.int32), keys[i], table, scratch)
+    total_edges += int(edges)
+  jax.block_until_ready(edges)
+  dt = time.time() - t0
+
+  eps = total_edges / dt
+  print(json.dumps({
+      'metric': 'sampled_edges_per_sec_per_chip',
+      'value': round(eps, 1),
+      'unit': 'edges/s',
+      'vs_baseline': round(eps / A100_ASSUMED_EDGES_PER_SEC, 4),
+  }))
+
+
+if __name__ == '__main__':
+  main()

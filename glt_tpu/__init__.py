@@ -11,3 +11,7 @@ __version__ = '0.1.0'
 from . import typing  # noqa: F401
 from . import utils  # noqa: F401
 from . import data  # noqa: F401
+from . import ops  # noqa: F401
+from . import sampler  # noqa: F401
+from . import loader  # noqa: F401
+from . import models  # noqa: F401

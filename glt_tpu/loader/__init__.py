@@ -1,0 +1,15 @@
+from .transform import (
+    Batch, HeteroBatch, to_batch, to_hetero_batch, to_torch_data,
+)
+from .node_loader import NodeLoader
+from .neighbor_loader import NeighborLoader
+from .link_loader import LinkLoader, LinkNeighborLoader, \
+    get_edge_label_index
+from .subgraph_loader import SubGraphLoader
+
+__all__ = [
+    'Batch', 'HeteroBatch', 'to_batch', 'to_hetero_batch', 'to_torch_data',
+    'NodeLoader', 'NeighborLoader',
+    'LinkLoader', 'LinkNeighborLoader', 'get_edge_label_index',
+    'SubGraphLoader',
+]

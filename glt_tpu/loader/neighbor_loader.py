@@ -1,0 +1,40 @@
+"""NeighborLoader — the user-facing mini-batch loader.
+
+Reference: graphlearn_torch/python/loader/neighbor_loader.py:27-112.
+Builds a NeighborSampler over the dataset's graph and yields Batch /
+HeteroBatch pytrees ready for a jitted train step.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..data import Dataset
+from ..sampler import NeighborSampler
+from .node_loader import NodeLoader
+
+
+class NeighborLoader(NodeLoader):
+  def __init__(self,
+               data: Dataset,
+               num_neighbors,
+               input_nodes,
+               batch_size: int = 512,
+               shuffle: bool = False,
+               drop_last: bool = False,
+               with_edge: bool = False,
+               with_weight: bool = False,
+               collect_features: bool = True,
+               replace: bool = False,
+               seed: Optional[int] = None,
+               device=None,
+               rng: Optional[np.random.Generator] = None):
+    sampler = NeighborSampler(
+        data.graph, num_neighbors,
+        device=device, with_edge=with_edge, with_weight=with_weight,
+        edge_dir=data.edge_dir, replace=replace, seed=seed)
+    super().__init__(data, sampler, input_nodes,
+                     batch_size=batch_size, shuffle=shuffle,
+                     drop_last=drop_last, collect_features=collect_features,
+                     rng=rng)

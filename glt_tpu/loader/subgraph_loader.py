@@ -1,0 +1,63 @@
+"""SubGraphLoader — induced-subgraph batches (SEAL-style workloads).
+
+Reference: graphlearn_torch/python/loader/subgraph_loader.py:27-100:
+sample the k-hop neighborhood of the seeds, extract the induced subgraph
+over it, return batches with a ``mapping`` from seed order to subgraph
+labels.
+"""
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..data import Dataset
+from ..sampler import NeighborSampler
+from ..utils import as_numpy
+from .node_loader import NodeLoader
+from .transform import Batch
+
+
+class SubGraphLoader(NodeLoader):
+  def __init__(self,
+               data: Dataset,
+               num_neighbors,
+               input_nodes,
+               batch_size: int = 512,
+               shuffle: bool = False,
+               drop_last: bool = False,
+               with_edge: bool = False,
+               collect_features: bool = True,
+               seed: Optional[int] = None,
+               device=None,
+               rng: Optional[np.random.Generator] = None):
+    sampler = NeighborSampler(
+        data.graph, num_neighbors, device=device, with_edge=with_edge,
+        edge_dir=data.edge_dir, seed=seed)
+    super().__init__(data, sampler, input_nodes, batch_size=batch_size,
+                     shuffle=shuffle, drop_last=drop_last,
+                     collect_features=collect_features, rng=rng)
+
+  def _make_batch(self, seeds: np.ndarray, n_valid: int) -> Batch:
+    sub = self.sampler.subgraph(seeds)
+    node_valid = jnp.arange(sub.nodes.shape[0]) < sub.node_count
+    x = None
+    if self.collect_features and self.data.node_features is not None:
+      x = self._gather_feature(self.data.get_node_feature(),
+                               jnp.maximum(sub.nodes, 0), sub.node_count)
+    y = None
+    if self.data.node_labels is not None:
+      y = jnp.asarray(self.data.get_node_label()[seeds])
+    # seeds are first-occurrence heads of the node list -> their labels
+    # are 0..batch_size-1 when seeds are unique (mapping metadata,
+    # reference subgraph_loader.py:90-100)
+    # framework orientation contract: row = child (message source),
+    # col = parent; induced_subgraph emits rows=expanding(parent)
+    return Batch(
+        x=x, row=sub.cols, col=sub.rows,
+        edge_mask=sub.edge_mask, node=sub.nodes,
+        node_count=sub.node_count, y=y, edge=sub.eids,
+        metadata={'mapping': jnp.arange(self.batch_size),
+                  'n_valid': n_valid},
+        batch_size=self.batch_size)

@@ -1,0 +1,140 @@
+"""Batch structures and SamplerOutput -> Batch conversion.
+
+Reference: graphlearn_torch/python/loader/transform.py:26-136 (to_data /
+to_hetero_data building PyG Data/HeteroData). Torch-geometric is not a
+TPU-side dependency, so the yielded object is a jax pytree (flax struct)
+carrying the same fields PyG models read — x, edge_index(row/col), y,
+batch, batch_size, num_sampled_nodes/edges — plus the padding masks that
+make every shape static. ``to_torch_data`` converts to a real PyG Data
+when torch_geometric is importable (CPU interop only).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from ..sampler.base import HeteroSamplerOutput, SamplerOutput
+from ..typing import EdgeType, NodeType
+
+
+@flax.struct.dataclass
+class Batch:
+  """Homogeneous mini-batch, padded static shapes throughout."""
+  x: Optional[jax.Array]            # [node_cap, D]
+  row: jax.Array                    # [edge_cap] child labels
+  col: jax.Array                    # [edge_cap] parent labels
+  edge_mask: jax.Array              # [edge_cap]
+  node: jax.Array                   # [node_cap] global node ids
+  node_count: jax.Array
+  y: Optional[jax.Array] = None     # [batch_size] seed labels
+  edge_attr: Optional[jax.Array] = None
+  edge: Optional[jax.Array] = None  # [edge_cap] edge ids
+  num_sampled_nodes: Optional[jax.Array] = None
+  num_sampled_edges: Optional[jax.Array] = None
+  metadata: Optional[Dict[str, Any]] = None
+  batch_size: int = flax.struct.field(pytree_node=False, default=0)
+  edge_hop_offsets: Optional[tuple] = flax.struct.field(
+      pytree_node=False, default=None)
+
+  @property
+  def edge_index(self) -> jax.Array:
+    return jnp.stack([self.row, self.col])
+
+  @property
+  def num_nodes(self) -> int:
+    return self.node.shape[0]
+
+  @property
+  def batch(self) -> jax.Array:
+    """Global ids of the seed nodes (first batch_size labels)."""
+    return self.node[:self.batch_size]
+
+
+@flax.struct.dataclass
+class HeteroBatch:
+  x_dict: Dict[NodeType, jax.Array]
+  row_dict: Dict[EdgeType, jax.Array]
+  col_dict: Dict[EdgeType, jax.Array]
+  edge_mask_dict: Dict[EdgeType, jax.Array]
+  node_dict: Dict[NodeType, jax.Array]
+  node_count_dict: Dict[NodeType, jax.Array]
+  y_dict: Optional[Dict[NodeType, jax.Array]] = None
+  edge_attr_dict: Optional[Dict[EdgeType, jax.Array]] = None
+  edge_dict: Optional[Dict[EdgeType, jax.Array]] = None
+  num_sampled_nodes: Optional[Dict[NodeType, jax.Array]] = None
+  num_sampled_edges: Optional[Dict[EdgeType, jax.Array]] = None
+  metadata: Optional[Dict[str, Any]] = None
+  input_type: Optional[NodeType] = flax.struct.field(
+      pytree_node=False, default=None)
+  batch_size: int = flax.struct.field(pytree_node=False, default=0)
+
+  def edge_index_dict(self) -> Dict[EdgeType, jax.Array]:
+    return {k: jnp.stack([self.row_dict[k], self.col_dict[k]])
+            for k in self.row_dict}
+
+  @property
+  def batch(self) -> jax.Array:
+    return self.node_dict[self.input_type][:self.batch_size]
+
+
+def to_batch(out: SamplerOutput,
+             x: Optional[jax.Array] = None,
+             y: Optional[jax.Array] = None,
+             edge_attr: Optional[jax.Array] = None,
+             batch_size: Optional[int] = None) -> Batch:
+  """Assemble a Batch from a SamplerOutput (+ gathered payloads)."""
+  return Batch(
+      x=x, y=y, edge_attr=edge_attr,
+      row=out.row, col=out.col, edge_mask=out.edge_mask,
+      node=out.node, node_count=out.node_count, edge=out.edge,
+      num_sampled_nodes=out.num_sampled_nodes,
+      num_sampled_edges=out.num_sampled_edges,
+      metadata=out.metadata,
+      batch_size=batch_size if batch_size is not None
+      else (out.batch.shape[0] if out.batch is not None else 0),
+      edge_hop_offsets=tuple(out.edge_hop_offsets)
+      if out.edge_hop_offsets else None,
+  )
+
+
+def to_hetero_batch(out: HeteroSamplerOutput,
+                    x_dict=None, y_dict=None, edge_attr_dict=None,
+                    batch_size: Optional[int] = None) -> HeteroBatch:
+  return HeteroBatch(
+      x_dict=x_dict or {},
+      row_dict=out.row, col_dict=out.col, edge_mask_dict=out.edge_mask,
+      node_dict=out.node, node_count_dict=out.node_count,
+      y_dict=y_dict, edge_attr_dict=edge_attr_dict, edge_dict=out.edge,
+      num_sampled_nodes=out.num_sampled_nodes,
+      num_sampled_edges=out.num_sampled_edges,
+      metadata=out.metadata, input_type=out.input_type,
+      batch_size=batch_size if batch_size is not None
+      else (out.batch[out.input_type].shape[0] if out.batch else 0),
+  )
+
+
+def to_torch_data(batch: Batch):
+  """Optional PyG interop (CPU): mirrors reference to_data field-for-field.
+  Requires torch_geometric; raises ImportError otherwise."""
+  import numpy as np
+  import torch
+  from torch_geometric.data import Data
+  em = np.asarray(batch.edge_mask)
+  edge_index = torch.as_tensor(
+      np.stack([np.asarray(batch.row)[em], np.asarray(batch.col)[em]]))
+  nc = int(batch.node_count)
+  data = Data(
+      x=torch.as_tensor(np.asarray(batch.x)[:nc])
+      if batch.x is not None else None,
+      edge_index=edge_index.long(),
+      y=torch.as_tensor(np.asarray(batch.y))
+      if batch.y is not None else None)
+  data.node = torch.as_tensor(np.asarray(batch.node)[:nc])
+  data.batch_size = batch.batch_size
+  if batch.num_sampled_nodes is not None:
+    data.num_sampled_nodes = np.asarray(batch.num_sampled_nodes).tolist()
+    data.num_sampled_edges = np.asarray(batch.num_sampled_edges).tolist()
+  return data

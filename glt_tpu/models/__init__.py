@@ -1,0 +1,4 @@
+from .conv import SAGEConv, GATConv, GCNConv, segment_mean
+from .sage import GraphSAGE
+
+__all__ = ['SAGEConv', 'GATConv', 'GCNConv', 'segment_mean', 'GraphSAGE']

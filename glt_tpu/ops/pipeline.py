@@ -1,0 +1,99 @@
+"""Functional multi-hop sampling pipeline.
+
+The hop loop shared by the single-device NeighborSampler and the SPMD
+(shard_map) training step: sample -> dense-induce -> advance frontier,
+all static shapes. Mirrors the reference homo loop
+(neighbor_sampler.py:186-230) with the padded-frontier design described
+in the NeighborSampler docstring.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .sample import NeighborOutput
+from .unique import dense_assign, dense_init, dense_reset
+
+OneHopFn = Callable[[jax.Array, int, jax.Array, jax.Array], NeighborOutput]
+
+
+def sample_budget(batch_size: int, fanouts: Sequence[int]) -> int:
+  budget, width = batch_size, batch_size
+  for k in fanouts:
+    width *= k
+    budget += width
+  return budget
+
+
+def edge_hop_offsets(batch_size: int, fanouts: Sequence[int]) -> List[int]:
+  offs, cap = [0], batch_size
+  for k in fanouts:
+    cap *= k
+    offs.append(offs[-1] + cap)
+  return offs
+
+
+def multihop_sample(one_hop: OneHopFn,
+                    seeds: jax.Array,
+                    n_valid: jax.Array,
+                    fanouts: Sequence[int],
+                    key: jax.Array,
+                    table: jax.Array,
+                    scratch: jax.Array,
+                    with_edge: bool = False) -> Dict[str, jax.Array]:
+  """Runs the full hop loop; returns (out_dict, table, scratch).
+
+  ``one_hop(frontier_ids, fanout, key, mask)`` performs one sampling hop.
+  Tables are returned reset, ready for the next batch.
+  """
+  batch_size = seeds.shape[0]
+  budget = sample_budget(batch_size, fanouts)
+  state = dense_init(table, scratch, budget)
+  seed_mask = jnp.arange(batch_size) < n_valid
+  state, seed_labels = dense_assign(state, seeds, seed_mask)
+  frontier_ids = jax.lax.slice(state.nodes, (0,), (batch_size,))
+  frontier_labels = jnp.arange(batch_size, dtype=jnp.int32)
+  frontier_mask = frontier_labels < state.count
+  seed_count = state.count
+
+  rows_parent, cols_child, emasks, eid_list = [], [], [], []
+  hop_node_counts = [seed_count]
+  hop_edge_counts = []
+  cap = batch_size
+  for fanout in fanouts:
+    key, sub = jax.random.split(key)
+    out = one_hop(frontier_ids, fanout, sub, frontier_mask)
+    prev_count = state.count
+    state, labels_flat = dense_assign(
+        state, out.nbrs.reshape(-1), out.mask.reshape(-1))
+    rows_parent.append(jnp.repeat(frontier_labels, fanout))
+    cols_child.append(labels_flat)
+    emasks.append(out.mask.reshape(-1))
+    if with_edge:
+      eid_list.append(out.eids.reshape(-1))
+    hop_node_counts.append(state.count - prev_count)
+    hop_edge_counts.append(out.mask.sum().astype(jnp.int32))
+    cap = cap * fanout
+    frontier_labels = prev_count + jnp.arange(cap, dtype=jnp.int32)
+    frontier_mask = frontier_labels < state.count
+    frontier_ids = jnp.take(state.nodes,
+                            jnp.minimum(frontier_labels, budget))
+
+  table, scratch = dense_reset(state)
+  out_dict = dict(
+      node=jax.lax.slice(state.nodes, (0,), (budget,)),
+      node_count=state.count,
+      row=jnp.concatenate(cols_child),
+      col=jnp.concatenate(rows_parent),
+      edge_mask=jnp.concatenate(emasks),
+      batch=jax.lax.slice(state.nodes, (0,), (batch_size,)),
+      seed_labels=seed_labels,
+      seed_count=seed_count,
+      num_sampled_nodes=jnp.stack(hop_node_counts),
+      num_sampled_edges=jnp.stack(hop_edge_counts),
+  )
+  if with_edge:
+    out_dict['edge'] = jnp.concatenate(eid_list)
+  return out_dict, table, scratch

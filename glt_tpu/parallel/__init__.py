@@ -1,0 +1,6 @@
+from .mesh import make_mesh, replicated, row_sharded
+from .dist_feature import ShardedFeature
+from .train import SPMDSageTrainStep
+
+__all__ = ['make_mesh', 'replicated', 'row_sharded', 'ShardedFeature',
+           'SPMDSageTrainStep']

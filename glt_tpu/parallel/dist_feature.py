@@ -1,0 +1,129 @@
+"""Sharded feature store with collective lookup — DistFeature, the SPMD way.
+
+Reference: graphlearn_torch/python/distributed/dist_feature.py:69-452. The
+reference looks up remote node features either by async RPC to the owner
+(dist_feature.py:380-430) or — the design SURVEY.md §7 says to keep — by a
+gloo all2all exchange (ids out, features back, dist_feature.py:270-366).
+Here that exchange is the native formulation: the feature table is one
+jax array row-sharded over the mesh ('range partition book': owner =
+id // rows_per_shard), and lookup inside shard_map is
+
+    bucket ids by owner -> all_to_all -> local gather -> all_to_all back
+    -> positional un-bucket (the stitch, stitch_sample_results.cu analog)
+
+with fixed-capacity buckets so shapes stay static. Collectives ride ICI.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils import as_numpy
+
+
+class ShardedFeature:
+  """[N, D] feature table row-sharded over one mesh axis.
+
+  The partition book is the range rule: owner(id) = id // rows_per_shard
+  (a RangePartitionBook with uniform bounds, reference
+  partition/partition_book.py:6-47).
+  """
+
+  def __init__(self, feats, mesh: Mesh, axis: str = 'data', dtype=None):
+    feats = as_numpy(feats)
+    self.mesh = mesh
+    self.axis = axis
+    n_shards = mesh.shape[axis]
+    n = feats.shape[0]
+    self.num_rows = n
+    self.rows_per_shard = math.ceil(n / n_shards)
+    pad = self.rows_per_shard * n_shards - n
+    if pad:
+      feats = np.concatenate(
+          [feats, np.zeros((pad,) + feats.shape[1:], feats.dtype)])
+    if dtype is not None:
+      feats = feats.astype(dtype)
+    self.feature_dim = feats.shape[1]
+    self.array = jax.device_put(
+        feats, NamedSharding(mesh, P(axis)))
+
+  # -- in-shard lookup ---------------------------------------------------
+
+  def lookup_local(self, local_shard: jax.Array, ids: jax.Array,
+                   valid: jax.Array, axis_name: Optional[str] = None
+                   ) -> jax.Array:
+    """Gather rows for global ``ids`` from inside shard_map.
+
+    Args:
+      local_shard: this device's [rows_per_shard, D] block (the shard_map
+        view of ``self.array``).
+      ids: [B] global row ids requested by this device.
+      valid: [B] mask.
+      axis_name: mesh axis to exchange over (defaults to ``self.axis``).
+
+    Returns [B, D]; invalid slots are zero.
+    """
+    ax = axis_name or self.axis
+    n_shards = self.mesh.shape[self.axis]
+    b = ids.shape[0]
+    owner = jnp.clip(ids // self.rows_per_shard, 0, n_shards - 1)
+    owner = jnp.where(valid, owner, n_shards)  # pads sort last
+    order = jnp.argsort(owner, stable=True)    # group requests by owner
+    ids_sorted = jnp.take(ids, order)
+    owner_sorted = jnp.take(owner, order)
+    counts = jnp.bincount(jnp.minimum(owner_sorted, n_shards),
+                          length=n_shards + 1)[:n_shards]
+    offsets = jnp.cumsum(counts) - counts
+    pos_in_bucket = jnp.arange(b) - jnp.take(
+        offsets, jnp.minimum(owner_sorted, n_shards - 1))
+    # fixed-capacity request buckets [n_shards, B]
+    sink_row, sink_col = n_shards, 0
+    brow = jnp.where(owner_sorted < n_shards, owner_sorted, sink_row)
+    req = jnp.full((n_shards + 1, b), -1, ids.dtype)
+    req = req.at[brow, jnp.where(owner_sorted < n_shards,
+                                 pos_in_bucket, sink_col)].set(ids_sorted)
+    req = req[:n_shards]
+    # exchange requests: row p of the result = what peer p asked us for
+    req_in = jax.lax.all_to_all(req, ax, split_axis=0, concat_axis=0,
+                                tiled=False)
+    req_in = req_in.reshape(n_shards, b)
+    # serve from the local block
+    my_index = jax.lax.axis_index(ax)
+    local_rows = req_in - my_index * self.rows_per_shard
+    ok = (local_rows >= 0) & (local_rows < self.rows_per_shard) & \
+        (req_in >= 0)
+    served = jnp.where(
+        ok[..., None],
+        jnp.take(local_shard, jnp.clip(local_rows, 0,
+                                       self.rows_per_shard - 1), axis=0),
+        0)
+    # send responses back; row p now holds our requests served by peer p
+    resp = jax.lax.all_to_all(served, ax, split_axis=0, concat_axis=0,
+                              tiled=False)
+    resp = resp.reshape(n_shards, b, self.feature_dim)
+    # positional stitch back to request order
+    gathered = resp[jnp.minimum(owner_sorted, n_shards - 1), pos_in_bucket]
+    gathered = jnp.where((owner_sorted < n_shards)[:, None], gathered, 0)
+    out = jnp.zeros_like(gathered)
+    out = out.at[order].set(gathered)
+    return out
+
+  def lookup(self, ids, valid=None) -> jax.Array:
+    """Whole-mesh lookup from the host side: ids [n_shards * B] laid out
+    shard-major; returns globally-sharded [n_shards * B, D]."""
+    ids = jnp.asarray(as_numpy(ids))
+    if valid is None:
+      valid = jnp.ones(ids.shape, bool)
+    n_shards = self.mesh.shape[self.axis]
+    assert ids.shape[0] % n_shards == 0
+    fn = jax.shard_map(
+        lambda shard, i, v: self.lookup_local(shard, i, v),
+        mesh=self.mesh,
+        in_specs=(P(self.axis), P(self.axis), P(self.axis)),
+        out_specs=P(self.axis), check_vma=False)
+    return fn(self.array, ids, valid)

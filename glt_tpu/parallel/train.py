@@ -1,0 +1,159 @@
+"""SPMD data-parallel training step: the DDP + distributed-feature loop
+as one shard_map program.
+
+Reference architecture being replaced (SURVEY.md §2.3): DDP/NCCL gradient
+allreduce + per-rank sampling workers + RPC feature lookup. TPU-native
+formulation: a single shard_map over the 'data' mesh axis where each
+device (1) samples its own seed shard against the replicated topology,
+(2) resolves features from the row-sharded feature table via the
+all_to_all exchange in ShardedFeature, (3) computes grads, (4) psums —
+the NCCL allreduce riding ICI. Params/optimizer state stay replicated.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..data import Graph
+from ..ops.pipeline import edge_hop_offsets, multihop_sample, sample_budget
+from ..ops.sample import sample_neighbors
+from ..ops.unique import dense_make_tables
+from ..loader.transform import Batch
+
+
+class SPMDSageTrainStep:
+  """Builds and runs the sharded sample+train step.
+
+  Args:
+    mesh: the device mesh (axis 'data').
+    model: a flax module consuming a Batch (e.g. models.GraphSAGE).
+    tx: optax optimizer.
+    graph: replicated Graph (HBM-resident topology on every chip; the
+      sharded-topology variant lives in glt_tpu.distributed).
+    feature: a ShardedFeature row-sharded over the mesh.
+    labels: [N] label array (replicated).
+    fanouts: per-hop fanouts.
+    batch_size_per_device: seed count per device per step.
+  """
+
+  def __init__(self, mesh: Mesh, model, tx, graph: Graph, feature,
+               labels, fanouts: Sequence[int],
+               batch_size_per_device: int, axis: str = 'data'):
+    self.mesh = mesh
+    self.model = model
+    self.tx = tx
+    self.graph = graph
+    self.feature = feature
+    self.fanouts = list(fanouts)
+    self.bs = batch_size_per_device
+    self.axis = axis
+    graph.lazy_init()
+    self.labels = jax.device_put(labels, NamedSharding(mesh, P()))
+    n_dev = mesh.shape[axis]
+    # per-device inducer tables, stacked on the mesh axis
+    table, scratch = dense_make_tables(graph.num_nodes)
+    self.tables = jax.device_put(
+        jnp.broadcast_to(table, (n_dev,) + table.shape),
+        NamedSharding(mesh, P(axis)))
+    self.scratches = jax.device_put(
+        jnp.broadcast_to(scratch, (n_dev,) + scratch.shape),
+        NamedSharding(mesh, P(axis)))
+    self._step_fn = self._build()
+
+  def init_params(self, key) -> dict:
+    batch = self._dummy_batch()
+    params = self.model.init(key, batch)
+    return jax.device_put(params, NamedSharding(self.mesh, P()))
+
+  def _dummy_batch(self) -> Batch:
+    budget = sample_budget(self.bs, self.fanouts)
+    ecap = edge_hop_offsets(self.bs, self.fanouts)[-1]
+    return Batch(
+        x=jnp.zeros((budget, self.feature.feature_dim)),
+        row=jnp.zeros((ecap,), jnp.int32),
+        col=jnp.zeros((ecap,), jnp.int32),
+        edge_mask=jnp.zeros((ecap,), bool),
+        node=jnp.zeros((budget,), jnp.int32),
+        node_count=jnp.zeros((), jnp.int32),
+        y=jnp.zeros((self.bs,), jnp.int32),
+        batch_size=self.bs,
+        edge_hop_offsets=tuple(edge_hop_offsets(self.bs, self.fanouts)),
+    )
+
+  def _build(self):
+    g = self.graph
+    indptr, indices = g.indptr, g.indices
+    feature = self.feature
+    model, tx, axis = self.model, self.tx, self.axis
+    fanouts, bs = self.fanouts, self.bs
+    offs = tuple(edge_hop_offsets(bs, fanouts))
+
+    def device_step(params, opt_state, table, scratch, seeds, n_valid,
+                    key, feat_shard, labels):
+      table = table[0]
+      scratch = scratch[0]
+      key = jax.random.fold_in(key[0], jax.lax.axis_index(axis))
+      one_hop = lambda ids, fanout, k, mask: sample_neighbors(
+          indptr, indices, ids, fanout, k, seed_mask=mask)
+      out, table, scratch = multihop_sample(
+          one_hop, seeds, n_valid[0], fanouts, key, table, scratch)
+      node_valid = jnp.arange(out['node'].shape[0]) < out['node_count']
+      x = feature.lookup_local(
+          feat_shard, jnp.maximum(out['node'], 0), node_valid,
+          axis_name=axis)
+      y = jnp.take(labels, jnp.maximum(out['batch'], 0)[:bs])
+      batch = Batch(
+          x=x, row=out['row'], col=out['col'], edge_mask=out['edge_mask'],
+          node=out['node'], node_count=out['node_count'], y=y,
+          batch_size=bs, edge_hop_offsets=offs)
+
+      def loss_fn(p):
+        logits = model.apply(p, batch)
+        mask = jnp.arange(bs) < n_valid[0]
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            logits, y)
+        return (jnp.where(mask, losses, 0).sum()
+                / jnp.maximum(mask.sum(), 1))
+
+      loss, grads = jax.value_and_grad(loss_fn)(params)
+      # DDP allreduce (mean over devices), riding ICI
+      grads = jax.lax.pmean(grads, axis)
+      loss = jax.lax.pmean(loss, axis)
+      updates, opt_state = tx.update(grads, opt_state, params)
+      params = optax.apply_updates(params, updates)
+      return (params, opt_state, table[None], scratch[None],
+              loss[None])
+
+    fn = jax.shard_map(
+        device_step, mesh=self.mesh,
+        in_specs=(P(), P(), P(self.axis), P(self.axis), P(self.axis),
+                  P(self.axis), P(self.axis), P(self.axis), P()),
+        out_specs=(P(), P(), P(self.axis), P(self.axis), P(self.axis)),
+        check_vma=False)
+
+    @functools.partial(jax.jit, donate_argnums=(2, 3))
+    def step(params, opt_state, tables, scratches, seeds, n_valid, keys):
+      return fn(params, opt_state, tables, scratches, seeds, n_valid,
+                keys, feature.array, self.labels)
+
+    return step
+
+  def __call__(self, params, opt_state, seeds, n_valid_per_device, keys):
+    """seeds: [n_dev * bs] shard-major; n_valid_per_device: [n_dev];
+    keys: [n_dev] PRNG keys. Returns (params, opt_state, loss[n_dev])."""
+    n_dev = self.mesh.shape[self.axis]
+    seeds = jax.device_put(
+        jnp.asarray(seeds, jnp.int32),
+        NamedSharding(self.mesh, P(self.axis)))
+    n_valid = jax.device_put(
+        jnp.asarray(n_valid_per_device, jnp.int32),
+        NamedSharding(self.mesh, P(self.axis)))
+    params, opt_state, self.tables, self.scratches, loss = self._step_fn(
+        params, opt_state, self.tables, self.scratches, seeds, n_valid,
+        keys)
+    return params, opt_state, loss

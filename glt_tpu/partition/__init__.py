@@ -1,0 +1,16 @@
+from .partition_book import (
+    PartitionBook, RangePartitionBook, TablePartitionBook,
+    infer_partition_book,
+)
+from .base import (
+    PartitionerBase, load_partition, load_meta, cat_feature_cache,
+)
+from .random_partitioner import RandomPartitioner
+from .frequency_partitioner import FrequencyPartitioner
+
+__all__ = [
+    'PartitionBook', 'RangePartitionBook', 'TablePartitionBook',
+    'infer_partition_book',
+    'PartitionerBase', 'load_partition', 'load_meta', 'cat_feature_cache',
+    'RandomPartitioner', 'FrequencyPartitioner',
+]

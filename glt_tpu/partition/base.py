@@ -1,0 +1,278 @@
+"""Offline graph/feature partitioning and the on-disk partition layout.
+
+Reference: graphlearn_torch/python/partition/base.py:192-582 (chunked
+PartitionerBase), 755-863 (load_partition), 866-907 (cat_feature_cache).
+The on-disk layout mirrors the reference's documented tree
+(partition/base.py:459-533), with numpy .npz payloads instead of torch
+saves:
+
+    root/
+      META.json                  {num_parts, data_cls, edge_dir,
+                                  node_types?, edge_types?, graph_caching}
+      node_pb.npy | node_pb/<ntype>.npy
+      edge_pb.npy | edge_pb/<etype-str>.npy
+      part{i}/
+        graph.npz | graph/<etype-str>.npz          rows, cols, eids[, weights]
+        node_feat.npz | node_feat/<ntype>.npz      feats, ids[, cache_feats,
+                                                    cache_ids]
+        edge_feat.npz | edge_feat/<etype-str>.npz  feats, ids
+
+Hetero payloads live in per-type subdirectories keyed by ``as_str``.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from ..typing import (
+    EdgeType, GraphPartitionData, FeaturePartitionData, NodeType, as_str,
+)
+from ..utils import as_numpy
+from .partition_book import PartitionBook, RangePartitionBook, \
+    TablePartitionBook
+
+CHUNK = 4 * 1024 * 1024
+
+
+def _etype_dir(etype: EdgeType) -> str:
+  return as_str(etype)
+
+
+class PartitionerBase:
+  """Chunked offline partitioner (abstract `_partition_node`).
+
+  Args:
+    output_dir: layout root.
+    num_parts: partition count.
+    num_nodes / num_edges: int (homo) or Dict keyed by type.
+    edge_index: [2, E] or dict — COO in original orientation (src, dst).
+    node_feat / edge_feat / edge_weights: optional arrays or dicts.
+    edge_assign_strategy: 'by_src' | 'by_dst' (reference base.py:292-372).
+    chunk_size: ids per processing chunk.
+  """
+
+  def __init__(self, output_dir: str, num_parts: int, num_nodes,
+               edge_index, node_feat=None, edge_feat=None,
+               edge_weights=None, edge_assign_strategy: str = 'by_src',
+               chunk_size: int = CHUNK, edge_dir: str = 'out'):
+    self.output_dir = output_dir
+    self.num_parts = int(num_parts)
+    self.is_hetero = isinstance(edge_index, dict)
+    self.num_nodes = num_nodes
+    self.edge_index = edge_index
+    self.node_feat = node_feat
+    self.edge_feat = edge_feat
+    self.edge_weights = edge_weights
+    assert edge_assign_strategy in ('by_src', 'by_dst')
+    self.edge_assign_strategy = edge_assign_strategy
+    self.chunk_size = int(chunk_size)
+    self.edge_dir = edge_dir
+
+  # -- abstract ----------------------------------------------------------
+
+  def _partition_node(self, ntype: Optional[NodeType] = None) -> np.ndarray:
+    """Returns the node partition table [num_nodes] int32."""
+    raise NotImplementedError
+
+  def _cache_node(self, ntype: Optional[NodeType] = None) \
+      -> Optional[np.ndarray]:
+    """Optional per-partition hot-cache rows: [num_parts, k] id arrays
+    (ragged: list of arrays). None = no caching."""
+    return None
+
+  # -- driver --------------------------------------------------------------
+
+  def partition(self) -> None:
+    os.makedirs(self.output_dir, exist_ok=True)
+    if self.is_hetero:
+      ntypes = set()
+      for (s, _, d) in self.edge_index:
+        ntypes.update((s, d))
+      node_pbs = {}
+      for nt in sorted(ntypes):
+        node_pbs[nt] = self._partition_node(nt)
+        self._save_pb(os.path.join('node_pb', nt), node_pbs[nt])
+      for etype, ei in self.edge_index.items():
+        self._partition_etype(etype, as_numpy(ei), node_pbs)
+      for nt in sorted(ntypes):
+        self._save_node_feat(nt, node_pbs[nt])
+      meta = dict(num_parts=self.num_parts, data_cls='hetero',
+                  edge_dir=self.edge_dir,
+                  node_types=sorted(ntypes),
+                  edge_types=[list(e) for e in self.edge_index])
+    else:
+      node_pb = self._partition_node()
+      self._save_pb('node_pb', node_pb)
+      self._partition_etype(None, as_numpy(self.edge_index),
+                            {None: node_pb})
+      self._save_node_feat(None, node_pb)
+      meta = dict(num_parts=self.num_parts, data_cls='homo',
+                  edge_dir=self.edge_dir)
+    with open(os.path.join(self.output_dir, 'META.json'), 'w') as f:
+      json.dump(meta, f)
+
+  # -- pieces --------------------------------------------------------------
+
+  def _save_pb(self, rel: str, pb: np.ndarray) -> None:
+    path = os.path.join(self.output_dir, rel + '.npy')
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    np.save(path, pb.astype(np.int32))
+
+  def _partition_etype(self, etype: Optional[EdgeType], ei: np.ndarray,
+                       node_pbs: Dict) -> None:
+    """Assign edges through the node PB in chunks and write per-part
+    graph payloads + the edge PB (reference base.py:292-372)."""
+    num_edges = ei.shape[1]
+    if etype is None:
+      src_pb = dst_pb = node_pbs[None]
+    else:
+      src_pb = node_pbs[etype[0]]
+      dst_pb = node_pbs[etype[2]]
+    anchor_pb = src_pb if self.edge_assign_strategy == 'by_src' else dst_pb
+    anchor_row = 0 if self.edge_assign_strategy == 'by_src' else 1
+
+    edge_pb = np.zeros(num_edges, dtype=np.int32)
+    per_part: List[List[np.ndarray]] = [[] for _ in range(self.num_parts)]
+    for lo in range(0, num_edges, self.chunk_size):
+      hi = min(lo + self.chunk_size, num_edges)
+      owner = anchor_pb[ei[anchor_row, lo:hi]]
+      edge_pb[lo:hi] = owner
+      for p in range(self.num_parts):
+        sel = np.nonzero(owner == p)[0] + lo
+        if sel.size:
+          per_part[p].append(sel)
+    ename = _etype_dir(etype) if etype else None
+    self._save_pb(os.path.join('edge_pb', ename) if ename else 'edge_pb',
+                  edge_pb)
+    w = (self.edge_weights.get(etype)
+         if isinstance(self.edge_weights, dict) else self.edge_weights)
+    w = as_numpy(w)
+    ef = (self.edge_feat.get(etype)
+          if isinstance(self.edge_feat, dict) else self.edge_feat)
+    ef = as_numpy(ef)
+    for p in range(self.num_parts):
+      eids = (np.concatenate(per_part[p]) if per_part[p]
+              else np.zeros(0, np.int64))
+      payload = dict(rows=ei[0, eids], cols=ei[1, eids], eids=eids)
+      if w is not None:
+        payload['weights'] = w[eids]
+      d = os.path.join(self.output_dir, f'part{p}',
+                       'graph' if ename is None else 'graph')
+      os.makedirs(d, exist_ok=True)
+      fname = (os.path.join(d, f'{ename}.npz') if ename
+               else os.path.join(d, 'data.npz'))
+      np.savez(fname, **payload)
+      if ef is not None:
+        fd = os.path.join(self.output_dir, f'part{p}', 'edge_feat')
+        os.makedirs(fd, exist_ok=True)
+        np.savez(os.path.join(fd, f'{ename}.npz') if ename
+                 else os.path.join(fd, 'data.npz'),
+                 feats=ef[eids], ids=eids)
+
+  def _save_node_feat(self, ntype: Optional[NodeType],
+                      node_pb: np.ndarray) -> None:
+    feat = (self.node_feat.get(ntype)
+            if isinstance(self.node_feat, dict) else self.node_feat)
+    feat = as_numpy(feat)
+    if feat is None:
+      return
+    cache = self._cache_node(ntype)
+    for p in range(self.num_parts):
+      ids = np.nonzero(node_pb == p)[0]
+      payload = dict(feats=feat[ids], ids=ids)
+      if cache is not None and cache[p].size:
+        payload['cache_feats'] = feat[cache[p]]
+        payload['cache_ids'] = cache[p]
+      d = os.path.join(self.output_dir, f'part{p}', 'node_feat')
+      os.makedirs(d, exist_ok=True)
+      np.savez(os.path.join(d, f'{ntype}.npz') if ntype
+               else os.path.join(d, 'data.npz'), **payload)
+
+
+# -- loading -----------------------------------------------------------------
+
+def _load_npz(path: str):
+  with np.load(path) as z:
+    return {k: z[k] for k in z.files}
+
+
+def load_meta(root: str) -> dict:
+  with open(os.path.join(root, 'META.json')) as f:
+    return json.load(f)
+
+
+def load_partition(root: str, part: int):
+  """Load one partition (reference base.py:755-863).
+
+  Returns (meta, graph_data, node_feat_data, edge_feat_data, node_pb,
+  edge_pb) where payloads are GraphPartitionData / FeaturePartitionData
+  (dicts keyed by type for hetero).
+  """
+  meta = load_meta(root)
+  hetero = meta['data_cls'] == 'hetero'
+  pdir = os.path.join(root, f'part{part}')
+
+  def load_graph(fname):
+    z = _load_npz(fname)
+    return GraphPartitionData(
+        edge_index=np.stack([z['rows'], z['cols']]),
+        eids=z['eids'], weights=z.get('weights'))
+
+  def load_feat(fname):
+    z = _load_npz(fname)
+    return FeaturePartitionData(
+        feats=z['feats'], ids=z['ids'],
+        cache_feats=z.get('cache_feats'), cache_ids=z.get('cache_ids'))
+
+  if hetero:
+    graph, nfeat, efeat = {}, {}, {}
+    etypes = [tuple(e) for e in meta['edge_types']]
+    for e in etypes:
+      graph[e] = load_graph(
+          os.path.join(pdir, 'graph', f'{_etype_dir(e)}.npz'))
+      ef = os.path.join(pdir, 'edge_feat', f'{_etype_dir(e)}.npz')
+      if os.path.exists(ef):
+        efeat[e] = load_feat(ef)
+    for nt in meta['node_types']:
+      nf = os.path.join(pdir, 'node_feat', f'{nt}.npz')
+      if os.path.exists(nf):
+        nfeat[nt] = load_feat(nf)
+    node_pb = {nt: TablePartitionBook(
+        np.load(os.path.join(root, 'node_pb', f'{nt}.npy')))
+        for nt in meta['node_types']}
+    edge_pb = {e: TablePartitionBook(
+        np.load(os.path.join(root, 'edge_pb', f'{_etype_dir(e)}.npy')))
+        for e in etypes}
+    return meta, graph, nfeat or None, efeat or None, node_pb, edge_pb
+
+  graph = load_graph(os.path.join(pdir, 'graph', 'data.npz'))
+  nf = os.path.join(pdir, 'node_feat', 'data.npz')
+  nfeat = load_feat(nf) if os.path.exists(nf) else None
+  ef = os.path.join(pdir, 'edge_feat', 'data.npz')
+  efeat = load_feat(ef) if os.path.exists(ef) else None
+  node_pb = TablePartitionBook(np.load(os.path.join(root, 'node_pb.npy')))
+  edge_pb = TablePartitionBook(np.load(os.path.join(root, 'edge_pb.npy')))
+  return meta, graph, nfeat, efeat, node_pb, edge_pb
+
+
+def cat_feature_cache(part: int, feat: FeaturePartitionData,
+                      pb: PartitionBook):
+  """Concat cached hot rows in front of owned rows, build the id->index
+  map, and rewrite the feature PB so cached remote ids resolve locally
+  (reference base.py:866-907)."""
+  table = (pb.table.copy() if isinstance(pb, TablePartitionBook)
+           else pb[np.arange(pb.bounds[-1])].copy())
+  if feat.cache_feats is None or feat.cache_ids is None:
+    feats = feat.feats
+    ids = feat.ids
+  else:
+    feats = np.concatenate([feat.cache_feats, feat.feats])
+    ids = np.concatenate([feat.cache_ids, feat.ids])
+    table[feat.cache_ids] = part
+  max_id = int(ids.max()) + 1 if ids.size else 0
+  id2index = np.full(max(max_id, table.shape[0]), -1, np.int64)
+  id2index[ids] = np.arange(ids.shape[0])
+  return feats, ids, id2index, TablePartitionBook(table)

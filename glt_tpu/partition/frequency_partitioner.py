@@ -1,0 +1,109 @@
+"""Frequency (hotness) partitioner.
+
+Reference: graphlearn_torch/python/partition/frequency_partitioner.py
+(26-205): per-partition access-probability vectors (from pre-sampling the
+training seeds of each partition, `NeighborSampler.sample_prob` /
+CalNbrProbKernel) drive a greedy chunk assignment maximizing local
+hotness; `_cache_node` then picks each partition's hottest remote rows
+under a cache budget.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..typing import NodeType
+from ..utils import as_numpy, parse_size
+from .base import PartitionerBase
+
+
+class FrequencyPartitioner(PartitionerBase):
+  """Args beyond PartitionerBase:
+
+    probs: [num_parts, num_nodes] access probabilities per target
+      partition (dict keyed by ntype for hetero). Row p comes from
+      sample_prob over partition p's training seeds.
+    cache_ratio / cache_memory_budget: per-partition hot-cache size as a
+      fraction of nodes or a byte budget ('1GB' etc.; converted using the
+      feature row nbytes).
+    balance: chunked greedy keeps partitions within chunk_size of each
+      other (the reference's per-chunk assignment).
+  """
+
+  def __init__(self, *args, probs=None, cache_ratio: float = 0.0,
+               cache_memory_budget: Union[int, str, None] = None,
+               **kwargs):
+    super().__init__(*args, **kwargs)
+    assert probs is not None, 'FrequencyPartitioner needs probs'
+    self.probs = probs
+    self.cache_ratio = float(cache_ratio)
+    self.cache_memory_budget = cache_memory_budget
+    self._pb_cache: Dict = {}
+
+  def _get_probs(self, ntype) -> np.ndarray:
+    p = self.probs[ntype] if isinstance(self.probs, dict) else self.probs
+    return np.stack([as_numpy(row) for row in p])
+
+  def _partition_node(self, ntype: Optional[NodeType] = None) -> np.ndarray:
+    if ntype in self._pb_cache:
+      return self._pb_cache[ntype]
+    probs = self._get_probs(ntype)          # [P, N]
+    num_parts, n = probs.shape
+    assert num_parts == self.num_parts
+    pb = np.full(n, -1, dtype=np.int32)
+    capacity = int(np.ceil(n / num_parts))
+    sizes = np.zeros(num_parts, dtype=np.int64)
+    # greedy chunked assignment by hotness gap (reference
+    # frequency_partitioner.py:123-171): nodes go to the partition that
+    # wants them most, subject to balance capacity
+    for lo in range(0, n, self.chunk_size):
+      hi = min(lo + self.chunk_size, n)
+      chunk = probs[:, lo:hi]               # [P, C]
+      order = np.argsort(-chunk, axis=0)    # partitions by desire
+      # iterate preference ranks; assign where capacity remains
+      assigned = np.full(hi - lo, False)
+      for rank in range(num_parts):
+        pref = order[rank]
+        for j in np.argsort(-chunk[pref, np.arange(hi - lo)]):
+          if assigned[j]:
+            continue
+          p = pref[j]
+          if sizes[p] < capacity:
+            pb[lo + j] = p
+            sizes[p] += 1
+            assigned[j] = True
+      # leftovers -> least-loaded
+      for j in np.nonzero(~assigned)[0]:
+        p = int(np.argmin(sizes))
+        pb[lo + j] = p
+        sizes[p] += 1
+    self._pb_cache[ntype] = pb
+    return pb
+
+  def _cache_node(self, ntype: Optional[NodeType] = None):
+    probs = self._get_probs(ntype)
+    n = probs.shape[1]
+    cache_num = int(n * self.cache_ratio)
+    if self.cache_memory_budget:
+      feat = (self.node_feat.get(ntype)
+              if isinstance(self.node_feat, dict) else self.node_feat)
+      feat = as_numpy(feat)
+      if feat is not None and feat.shape[0]:
+        row_bytes = feat[0].nbytes
+        budget_num = int(parse_size(self.cache_memory_budget)
+                         // max(row_bytes, 1))
+        # the byte budget is an upper bound: the smaller of the two wins
+        # (reference frequency_partitioner.py:188-198)
+        cache_num = min(cache_num, budget_num) if cache_num else budget_num
+    cache_num = min(cache_num, n)
+    if cache_num <= 0:
+      return None
+    pb = self._partition_node(ntype)
+    out = []
+    for p in range(self.num_parts):
+      score = probs[p].copy()
+      score[pb == p] = -1.0                 # owned rows need no cache
+      hot = np.argsort(-score)[:cache_num]
+      out.append(hot[score[hot] > 0])
+    return out

@@ -1,0 +1,83 @@
+"""Partition books: id -> partition maps.
+
+Reference: graphlearn_torch/python/partition/partition_book.py (
+RangePartitionBook:6-47 with OffsetId2Index:50-64, GLTPartitionBook:67-72)
+and the abstract base (partition/base.py:30-37). Payloads are numpy on the
+host and convert to jnp for in-jit routing (the SPMD sampler uses these to
+bucket ids by owner before all_to_all).
+"""
+from __future__ import annotations
+
+from typing import Union
+
+import numpy as np
+
+from ..utils import as_numpy
+
+
+class PartitionBook:
+  """Abstract id -> partition-index mapping."""
+
+  def __getitem__(self, ids) -> np.ndarray:
+    raise NotImplementedError
+
+  @property
+  def device_array(self):
+    """A representation usable inside jit (see subclasses)."""
+    raise NotImplementedError
+
+
+class RangePartitionBook(PartitionBook):
+  """Partitions are consecutive id ranges; bounds[i] is the exclusive end
+  of partition i (reference partition_book.py:6-47)."""
+
+  def __init__(self, bounds):
+    self.bounds = as_numpy(bounds).astype(np.int64)
+    assert np.all(np.diff(self.bounds) >= 0)
+
+  def __getitem__(self, ids) -> np.ndarray:
+    ids = as_numpy(ids)
+    return np.searchsorted(self.bounds, ids, side='right').astype(np.int32)
+
+  @property
+  def num_partitions(self) -> int:
+    return int(self.bounds.shape[0])
+
+  @property
+  def device_array(self):
+    import jax.numpy as jnp
+    return jnp.asarray(self.bounds)
+
+  def id2index(self, ids) -> np.ndarray:
+    """Global id -> index within its owner partition
+    (reference OffsetId2Index:50-64)."""
+    ids = as_numpy(ids).astype(np.int64)
+    part = self[ids]
+    starts = np.concatenate([[0], self.bounds[:-1]])
+    return ids - starts[part]
+
+
+class TablePartitionBook(PartitionBook):
+  """Dense per-id table (the reference's GLTPartitionBook:67-72)."""
+
+  def __init__(self, table):
+    self.table = as_numpy(table).astype(np.int32)
+
+  def __getitem__(self, ids) -> np.ndarray:
+    return self.table[as_numpy(ids)]
+
+  @property
+  def num_partitions(self) -> int:
+    return int(self.table.max()) + 1 if self.table.size else 0
+
+  @property
+  def device_array(self):
+    import jax.numpy as jnp
+    return jnp.asarray(self.table)
+
+
+def infer_partition_book(obj) -> PartitionBook:
+  if isinstance(obj, PartitionBook):
+    return obj
+  arr = as_numpy(obj)
+  return TablePartitionBook(arr)

@@ -1,0 +1,29 @@
+"""Random node partitioner (reference partition/random_partitioner.py:28-86):
+ids assigned round-robin under a random permutation; no feature caching."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..typing import NodeType
+from .base import PartitionerBase
+
+
+class RandomPartitioner(PartitionerBase):
+  def __init__(self, *args, seed: int = 0, **kwargs):
+    super().__init__(*args, **kwargs)
+    self.seed = seed
+
+  def _partition_node(self, ntype: Optional[NodeType] = None) -> np.ndarray:
+    n = (self.num_nodes[ntype] if isinstance(self.num_nodes, dict)
+         else self.num_nodes)
+    import zlib
+    # crc32, not hash(): python string hashing is per-process randomized
+    rng = np.random.default_rng(
+        self.seed if ntype is None
+        else self.seed + zlib.crc32(ntype.encode()) % 9973)
+    perm = rng.permutation(n)
+    pb = np.empty(n, dtype=np.int32)
+    pb[perm] = np.arange(n, dtype=np.int64) % self.num_parts
+    return pb

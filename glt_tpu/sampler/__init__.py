@@ -1,0 +1,13 @@
+from .base import (
+    BaseSampler, EdgeSamplerInput, HeteroSamplerOutput, NegativeSampling,
+    NodeSamplerInput, SamplerOutput, SamplingConfig, SamplingType,
+)
+from .neighbor_sampler import NeighborSampler
+from .negative_sampler import RandomNegativeSampler
+
+__all__ = [
+    'BaseSampler', 'EdgeSamplerInput', 'HeteroSamplerOutput',
+    'NegativeSampling', 'NodeSamplerInput', 'SamplerOutput',
+    'SamplingConfig', 'SamplingType',
+    'NeighborSampler', 'RandomNegativeSampler',
+]

@@ -72,9 +72,9 @@ class NegativeSampling:
     return self.mode == 'triplet'
 
   def sample_size(self, num_pos: int) -> int:
-    if isinstance(self.amount, float):
-      return int(round(num_pos * self.amount))
-    return int(num_pos * self.amount)
+    # ceil matches the reference sampler's num_neg computation
+    # (neighbor_sampler.py:344)
+    return int(math.ceil(num_pos * float(self.amount)))
 
 
 @dataclasses.dataclass

@@ -1,0 +1,43 @@
+"""Negative sampler wrapper (reference sampler/negative_sampler.py:21-57):
+chooses row/col id spaces by edge_dir and delegates to the strict/padded
+negative sampling op."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ..data import Graph
+from ..ops.negative import NegativeOutput, random_negative_sample
+from ..utils.rng import RandomSeedManager
+
+
+class RandomNegativeSampler:
+  """Samples (src, dst) non-edges from a Graph.
+
+  ``mode='strict'`` rejects existing edges (binary-search membership);
+  ``padding=True`` always returns a full batch (reference semantics,
+  negative_sampler.py:39-57).
+  """
+
+  def __init__(self, graph: Graph, mode: str = 'strict',
+               edge_dir: str = 'out'):
+    self.graph = graph
+    self.strict = (mode == 'strict')
+    self.edge_dir = edge_dir
+
+  def sample(self, req_num: int, trials_num: int = 5,
+             padding: bool = False,
+             key: Optional[jax.Array] = None) -> NegativeOutput:
+    g = self.graph
+    if key is None:
+      key = RandomSeedManager.getInstance().nextKey()
+    out = random_negative_sample(
+        g.indptr, g.indices, req_num=req_num, trials_num=trials_num,
+        key=key, num_rows=g.topo.num_rows, num_cols=g.topo.num_cols,
+        strict=self.strict, padding=padding)
+    if (self.edge_dir == 'in'):
+      # stored layout is CSC (rows = dst): swap so callers always get
+      # (src, dst) pairs in original-graph orientation
+      return NegativeOutput(rows=out.cols, cols=out.rows, mask=out.mask)
+    return out

@@ -1,0 +1,84 @@
+"""Link sampling / LinkNeighborLoader / SubGraphLoader tests."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from glt_tpu.loader import LinkNeighborLoader, SubGraphLoader
+from glt_tpu.sampler import (
+    EdgeSamplerInput, NegativeSampling, NeighborSampler,
+)
+
+from fixtures import ring_dataset
+
+
+@pytest.fixture(scope='module')
+def ring():
+  return ring_dataset(num_nodes=40)
+
+
+def test_sample_from_edges_binary(ring):
+  s = NeighborSampler(ring.get_graph(), [2], seed=0)
+  rows = np.array([0, 1, 2, 3])
+  cols = (rows + 1) % 40
+  out = s.sample_from_edges(EdgeSamplerInput(
+      rows, cols, neg_sampling=NegativeSampling('binary', amount=1)))
+  meta = out.metadata
+  eli = np.asarray(meta['edge_label_index'])
+  assert eli.shape == (2, 8)   # 4 pos + 4 neg
+  lab = np.asarray(meta['edge_label'])
+  np.testing.assert_array_equal(lab, [1, 1, 1, 1, 0, 0, 0, 0])
+  # labels resolve back to the original endpoints
+  node = np.asarray(out.node)
+  np.testing.assert_array_equal(node[eli[0, :4]], rows)
+  np.testing.assert_array_equal(node[eli[1, :4]], cols)
+
+
+def test_sample_from_edges_triplet(ring):
+  s = NeighborSampler(ring.get_graph(), [2], seed=1)
+  rows = np.array([5, 6])
+  cols = (rows + 2) % 40
+  out = s.sample_from_edges(EdgeSamplerInput(
+      rows, cols, neg_sampling=NegativeSampling('triplet', amount=2)))
+  meta = out.metadata
+  node = np.asarray(out.node)
+  np.testing.assert_array_equal(node[np.asarray(meta['src_index'])], rows)
+  np.testing.assert_array_equal(node[np.asarray(meta['dst_pos_index'])],
+                                cols)
+  assert np.asarray(meta['dst_neg_index']).shape == (2, 2)
+
+
+def test_link_neighbor_loader_epoch(ring):
+  loader = LinkNeighborLoader(
+      ring, [2], batch_size=16, shuffle=True, seed=0,
+      neg_sampling=NegativeSampling('binary', amount=1),
+      rng=np.random.default_rng(3))
+  batches = list(loader)
+  assert len(batches) == 5  # 80 edges / 16
+  b = batches[0]
+  eli = np.asarray(b.metadata['edge_label_index'])
+  assert eli.shape == (2, 32)
+  node = np.asarray(b.node)
+  # positive pairs obey the ring relation
+  src = node[eli[0, :16]]
+  dst = node[eli[1, :16]]
+  for u, v in zip(src, dst):
+    assert v in ((u + 1) % 40, (u + 2) % 40)
+  # features present for all valid nodes
+  nc = int(b.node_count)
+  np.testing.assert_allclose(np.asarray(b.x)[:nc, 0], node[:nc])
+
+
+def test_subgraph_loader(ring):
+  loader = SubGraphLoader(ring, [2, 2], input_nodes=np.arange(8),
+                          batch_size=8, seed=0)
+  b = next(iter(loader))
+  nc = int(b.node_count)
+  nodes = np.asarray(b.node)[:nc]
+  # 2-hop from seeds 0..7 covers 0..11
+  assert set(nodes.tolist()) == set(range(12))
+  em = np.asarray(b.edge_mask)
+  child = nodes[np.asarray(b.row)[em]]
+  parent = nodes[np.asarray(b.col)[em]]
+  for p, c in zip(parent, child):
+    assert c in ((p + 1) % 40, (p + 2) % 40)
+  np.testing.assert_allclose(np.asarray(b.x)[:nc, 0], nodes)

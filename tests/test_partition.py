@@ -1,0 +1,119 @@
+"""Partitioning round-trip tests (reference test_partition.py pattern:
+save/load + PB correctness for random & frequency partitioners)."""
+import numpy as np
+import pytest
+
+from glt_tpu.partition import (
+    FrequencyPartitioner, RandomPartitioner, RangePartitionBook,
+    TablePartitionBook, cat_feature_cache, load_meta, load_partition,
+)
+
+from fixtures import ring_edges
+
+
+def test_range_partition_book():
+  pb = RangePartitionBook([10, 20, 40])
+  np.testing.assert_array_equal(pb[np.array([0, 9, 10, 19, 20, 39])],
+                                [0, 0, 1, 1, 2, 2])
+  np.testing.assert_array_equal(pb.id2index(np.array([0, 9, 10, 25])),
+                                [0, 9, 0, 5])
+  assert pb.num_partitions == 3
+
+
+def test_table_partition_book():
+  pb = TablePartitionBook(np.array([0, 1, 1, 0]))
+  np.testing.assert_array_equal(pb[np.array([1, 3])], [1, 0])
+  assert pb.num_partitions == 2
+
+
+def _make_inputs(n=40, feat_dim=4):
+  rows, cols, eids = ring_edges(n)
+  feats = np.tile(np.arange(n, dtype=np.float32)[:, None], (1, feat_dim))
+  efeats = np.tile(np.arange(2 * n, dtype=np.float32)[:, None], (1, 2))
+  return np.stack([rows, cols]), feats, efeats
+
+
+def test_random_partitioner_roundtrip(tmp_path):
+  ei, feats, efeats = _make_inputs()
+  p = RandomPartitioner(str(tmp_path), num_parts=2, num_nodes=40,
+                        edge_index=ei, node_feat=feats, edge_feat=efeats,
+                        edge_assign_strategy='by_src')
+  p.partition()
+  meta = load_meta(str(tmp_path))
+  assert meta['num_parts'] == 2 and meta['data_cls'] == 'homo'
+
+  seen_nodes, seen_edges = [], []
+  for part in range(2):
+    _, graph, nfeat, efeat, node_pb, edge_pb = load_partition(
+        str(tmp_path), part)
+    # every edge's src is owned by this partition (by_src)
+    np.testing.assert_array_equal(node_pb[graph.edge_index[0]], part)
+    np.testing.assert_array_equal(edge_pb[graph.eids], part)
+    # features are value-encoded: row for id i has value i
+    np.testing.assert_allclose(nfeat.feats[:, 0], nfeat.ids)
+    np.testing.assert_allclose(efeat.feats[:, 0], efeat.ids)
+    seen_nodes.append(nfeat.ids)
+    seen_edges.append(graph.eids)
+  np.testing.assert_array_equal(np.sort(np.concatenate(seen_nodes)),
+                                np.arange(40))
+  np.testing.assert_array_equal(np.sort(np.concatenate(seen_edges)),
+                                np.arange(80))
+
+
+def test_frequency_partitioner_with_cache(tmp_path):
+  ei, feats, _ = _make_inputs()
+  # partition 0 is hot on nodes 0..19, partition 1 on 20..39
+  probs = np.zeros((2, 40), np.float32)
+  probs[0, :20] = 1.0
+  probs[1, 20:] = 1.0
+  # both partitions also want node 0 and 20 a bit (cache candidates)
+  probs[1, 0] = 0.5
+  probs[0, 20] = 0.5
+  p = FrequencyPartitioner(str(tmp_path), num_parts=2, num_nodes=40,
+                           edge_index=ei, node_feat=feats,
+                           probs=probs, cache_ratio=0.1)
+  p.partition()
+  _, graph0, nfeat0, _, node_pb, _ = load_partition(str(tmp_path), 0)
+  # hot nodes landed where they're hottest
+  assert set(np.nonzero(node_pb.table == 0)[0]) == set(range(20))
+  # partition 0 cached node 20 (hot remote row)
+  _, _, nf0, _, _, _ = load_partition(str(tmp_path), 0)
+  assert nf0.cache_ids is not None and 20 in nf0.cache_ids
+  _, _, nf1, _, _, _ = load_partition(str(tmp_path), 1)
+  assert 0 in nf1.cache_ids
+
+
+def test_cat_feature_cache_rewrites_pb(tmp_path):
+  ei, feats, _ = _make_inputs()
+  probs = np.zeros((2, 40), np.float32)
+  probs[0, :20] = 1.0
+  probs[1, 20:] = 1.0
+  probs[0, 20] = 0.5
+  p = FrequencyPartitioner(str(tmp_path), num_parts=2, num_nodes=40,
+                           edge_index=ei, node_feat=feats,
+                           probs=probs, cache_ratio=0.05)
+  p.partition()
+  _, _, nfeat, _, node_pb, _ = load_partition(str(tmp_path), 0)
+  feats_cat, ids, id2index, new_pb = cat_feature_cache(0, nfeat, node_pb)
+  # cached remote id 20 now resolves to partition 0
+  assert new_pb[np.array([20])][0] == 0
+  # id2index maps every held id to its row
+  for gid in ids:
+    np.testing.assert_allclose(feats_cat[id2index[gid]][0], gid)
+
+
+def test_hetero_partition_roundtrip(tmp_path):
+  u2i = ('user', 'u2i', 'item')
+  ei = {u2i: np.array([[0, 1, 2, 3], [9, 5, 7, 1]])}
+  nfeat = {'user': np.arange(4, dtype=np.float32)[:, None],
+           'item': np.arange(10, dtype=np.float32)[:, None]}
+  p = RandomPartitioner(str(tmp_path), num_parts=2,
+                        num_nodes={'user': 4, 'item': 10},
+                        edge_index=ei, node_feat=nfeat)
+  p.partition()
+  meta, graph, nf, ef, node_pb, edge_pb = load_partition(str(tmp_path), 0)
+  assert meta['data_cls'] == 'hetero'
+  assert u2i in graph
+  assert set(nf) <= {'user', 'item'}
+  assert node_pb['user'].table.shape[0] == 4
+  assert node_pb['item'].table.shape[0] == 10

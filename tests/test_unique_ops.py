@@ -98,3 +98,38 @@ def test_stitch_rows_pad_does_not_clobber_row_zero():
       [jnp.array([[42.], [99.]]), jnp.array([[7.]])],
       total=2)
   np.testing.assert_allclose(np.asarray(out), [[42.], [7.]])
+
+
+def test_dense_inducer_matches_sorted_inducer():
+  from glt_tpu.ops.unique import (
+      dense_make_tables, dense_init, dense_assign, dense_reset)
+  n = 100
+  table, scratch = dense_make_tables(n)
+  state = dense_init(table, scratch, capacity=16)
+  seeds = jnp.array([10, 20, 10, 30])
+  state, labels = dense_assign(state, seeds, jnp.ones(4, bool))
+  np.testing.assert_array_equal(np.asarray(labels), [0, 1, 0, 2])
+  assert int(state.count) == 3
+  # second wave: mixes existing (20) and new (40, 50), with invalid slots
+  ids = jnp.array([40, 20, 40, 50, 99])
+  valid = jnp.array([True, True, True, True, False])
+  state, labels = dense_assign(state, ids, valid)
+  np.testing.assert_array_equal(np.asarray(labels), [3, 1, 3, 4, -1])
+  np.testing.assert_array_equal(np.asarray(state.nodes)[:5],
+                                [10, 20, 30, 40, 50])
+  # reset clears only touched entries
+  table, scratch = dense_reset(state)
+  assert int(np.asarray(table).max()) == -1 or np.all(np.asarray(table) == -1)
+  assert np.all(np.asarray(scratch) == np.iinfo(np.int32).max)
+
+
+def test_dense_inducer_reuse_after_reset():
+  from glt_tpu.ops.unique import (
+      dense_make_tables, dense_init, dense_assign, dense_reset)
+  table, scratch = dense_make_tables(50)
+  state = dense_init(table, scratch, capacity=8)
+  state, _ = dense_assign(state, jnp.array([5, 6]), jnp.ones(2, bool))
+  table, scratch = dense_reset(state)
+  state2 = dense_init(table, scratch, capacity=8)
+  state2, labels = dense_assign(state2, jnp.array([7, 5]), jnp.ones(2, bool))
+  np.testing.assert_array_equal(np.asarray(labels), [0, 1])
