@@ -1,0 +1,102 @@
+"""Server-client deployment — the reference's
+examples/distributed/server_client_mode/: sampling servers (CPU hosts)
+feed a training client over rpc with prefetching. One-host demo with
+server subprocesses.
+"""
+import argparse
+import multiprocessing as mp
+import os
+import sys
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), '..'))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), '..', '..'))
+
+import numpy as np
+
+
+def build_dataset():
+  sys.path.insert(0, os.path.join(os.path.dirname(
+      os.path.abspath(__file__)), '..'))
+  from common import synthetic_products
+  ds, _ = synthetic_products(num_nodes=4_000)
+  return ds
+
+
+def run_server(rank, num_servers, port):
+  import jax
+  try:
+    jax.config.update('jax_platforms', 'cpu')
+  except Exception:
+    pass
+  from glt_tpu.distributed import init_server, wait_and_shutdown_server
+  init_server(num_servers=num_servers, num_clients=1, server_rank=rank,
+              dataset=build_dataset(), master_port=port,
+              dataset_builder=build_dataset)
+  wait_and_shutdown_server()
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--num-servers', type=int, default=2)
+  ap.add_argument('--port', type=int, default=29600)
+  args = ap.parse_args()
+
+  ctx = mp.get_context('spawn')
+  servers = [ctx.Process(target=run_server,
+                         args=(r, args.num_servers, args.port))
+             for r in range(args.num_servers)]
+  for s in servers:
+    s.start()
+
+  import time
+  time.sleep(3)
+  import jax
+  import jax.numpy as jnp
+  import optax
+  from glt_tpu.distributed import (
+      RemoteDistSamplingWorkerOptions, RemoteNeighborLoader, init_client,
+      shutdown_client,
+  )
+  from glt_tpu.models import GraphSAGE
+
+  init_client(args.num_servers, 1, 0, master_port=args.port)
+  n = 4_000
+  per_server = np.array_split(np.arange(n), args.num_servers)
+  loader = RemoteNeighborLoader(
+      [10, 5], per_server, batch_size=128, shuffle=True,
+      collect_features=True, seed=0,
+      worker_options=RemoteDistSamplingWorkerOptions(
+          server_rank=list(range(args.num_servers)), prefetch_size=4))
+
+  model = GraphSAGE(hidden_features=64, out_features=47, num_layers=2)
+  params = None
+  tx = optax.adam(1e-3)
+
+  @jax.jit
+  def step(params, opt, batch):
+    def loss_fn(p):
+      logits = model.apply(p, batch)
+      mask = jnp.arange(logits.shape[0]) < batch.metadata['n_valid']
+      l = optax.softmax_cross_entropy_with_integer_labels(logits, batch.y)
+      return jnp.where(mask, l, 0).sum() / jnp.maximum(mask.sum(), 1)
+    loss, g = jax.value_and_grad(loss_fn)(params)
+    up, opt = tx.update(g, opt)
+    return optax.apply_updates(params, up), opt, loss
+
+  for epoch in range(2):
+    for batch in loader:
+      if params is None:
+        params = model.init(jax.random.key(0), batch)
+        opt = tx.init(params)
+      meta = dict(batch.metadata)
+      meta['n_valid'] = jnp.asarray(meta['n_valid'])
+      params, opt, loss = step(params, opt, batch.replace(metadata=meta))
+    print(f'epoch {epoch}: loss={float(loss):.4f}')
+
+  shutdown_client()
+  for s in servers:
+    s.join(timeout=15)
+  print('done')
+
+
+if __name__ == '__main__':
+  main()

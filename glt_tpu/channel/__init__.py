@@ -1,0 +1,11 @@
+from .base import ChannelBase, SampleMessage, pack_message, unpack_message
+from .shm import ShmQueue, QueueTimeoutError
+from .shm_channel import ShmChannel
+from .mp_channel import MpChannel
+from .remote_channel import RemoteReceivingChannel
+
+__all__ = [
+    'ChannelBase', 'SampleMessage', 'pack_message', 'unpack_message',
+    'ShmQueue', 'QueueTimeoutError',
+    'ShmChannel', 'MpChannel', 'RemoteReceivingChannel',
+]

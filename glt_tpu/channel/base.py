@@ -1,0 +1,96 @@
+"""Channel abstractions + the flat SampleMessage wire format.
+
+Reference: graphlearn_torch/python/channel/base.py (ChannelBase:25,
+SampleMessage:28 = Dict[str, Tensor]) and the native TensorMapSerializer
+(csrc/tensor_map.cc, include/tensor_map.h:24-52). SampleMessage here is
+Dict[str, np.ndarray]; pack/unpack use the same flat binary layout
+(|n| key_len|key|dtype|ndim|shape…|nbytes|data|) with zero-copy
+``np.frombuffer`` views on the receive side.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict
+
+import numpy as np
+
+SampleMessage = Dict[str, np.ndarray]
+
+_DTYPES = [np.dtype(d) for d in (
+    'bool', 'int8', 'uint8', 'int16', 'int32', 'int64',
+    'float16', 'float32', 'float64',
+)]
+_DTYPE_CODE = {d: i for i, d in enumerate(_DTYPES)}
+# bfloat16 rides as uint16 payload with its own code
+_BF16_CODE = len(_DTYPES)
+
+
+def _dtype_code(dt: np.dtype) -> int:
+  if dt.name == 'bfloat16':
+    return _BF16_CODE
+  return _DTYPE_CODE[np.dtype(dt)]
+
+
+def _code_dtype(code: int):
+  if code == _BF16_CODE:
+    import ml_dtypes
+    return np.dtype(ml_dtypes.bfloat16)
+  return _DTYPES[code]
+
+
+def pack_message(msg: SampleMessage) -> bytes:
+  """Serialize (TensorMapSerializer::Serialize equivalent)."""
+  parts = [struct.pack('<I', len(msg))]
+  for key, arr in msg.items():
+    arr = np.ascontiguousarray(arr)
+    kb = key.encode()
+    parts.append(struct.pack('<I', len(kb)))
+    parts.append(kb)
+    parts.append(struct.pack('<II', _dtype_code(arr.dtype), arr.ndim))
+    parts.append(struct.pack(f'<{max(arr.ndim,1)}Q',
+                             *(arr.shape or (0,))))
+    raw = arr.tobytes()
+    parts.append(struct.pack('<Q', len(raw)))
+    parts.append(raw)
+  return b''.join(parts)
+
+
+def unpack_message(buf: bytes) -> SampleMessage:
+  """Deserialize with zero-copy views (TensorMapSerializer::Load)."""
+  out: SampleMessage = {}
+  (n,) = struct.unpack_from('<I', buf, 0)
+  off = 4
+  for _ in range(n):
+    (klen,) = struct.unpack_from('<I', buf, off)
+    off += 4
+    key = buf[off:off + klen].decode()
+    off += klen
+    code, ndim = struct.unpack_from('<II', buf, off)
+    off += 8
+    shape = struct.unpack_from(f'<{max(ndim,1)}Q', buf, off)
+    off += 8 * max(ndim, 1)
+    if ndim == 0:
+      shape = ()
+    else:
+      shape = shape[:ndim]
+    (nbytes,) = struct.unpack_from('<Q', buf, off)
+    off += 8
+    total = int(np.prod(shape)) if ndim else 1
+    arr = np.frombuffer(buf, dtype=_code_dtype(code), count=total,
+                        offset=off).reshape(shape)
+    out[key] = arr
+    off += nbytes
+  return out
+
+
+class ChannelBase:
+  """Producer->consumer byte channel of SampleMessages."""
+
+  def send(self, msg: SampleMessage) -> None:
+    raise NotImplementedError
+
+  def recv(self, timeout_ms: int = 60_000) -> SampleMessage:
+    raise NotImplementedError
+
+  def empty(self) -> bool:
+    raise NotImplementedError

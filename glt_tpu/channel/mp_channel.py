@@ -1,0 +1,28 @@
+"""MpChannel — multiprocessing.Queue-backed channel (reference
+channel/mp_channel.py:21): the portable fallback when SysV shm is
+unavailable; payloads pickle through the mp pipe."""
+from __future__ import annotations
+
+import multiprocessing as mp
+import queue as _queue
+
+from .base import ChannelBase, SampleMessage
+from .shm import QueueTimeoutError
+
+
+class MpChannel(ChannelBase):
+  def __init__(self, capacity: int = 64):
+    ctx = mp.get_context('spawn')
+    self._queue = ctx.Queue(maxsize=capacity)
+
+  def send(self, msg: SampleMessage, timeout_ms: int = 60_000) -> None:
+    self._queue.put(msg, timeout=timeout_ms / 1000)
+
+  def recv(self, timeout_ms: int = 60_000) -> SampleMessage:
+    try:
+      return self._queue.get(timeout=timeout_ms / 1000)
+    except _queue.Empty as e:
+      raise QueueTimeoutError('recv timed out') from e
+
+  def empty(self) -> bool:
+    return self._queue.empty()

@@ -1,0 +1,77 @@
+"""RemoteReceivingChannel — pull-prefetching consumer over remote fetchers.
+
+Reference: graphlearn_torch/python/channel/remote_channel.py:24-131: pulls
+``prefetch_size`` messages per server concurrently and tracks per-server
+end-of-epoch markers. The fetcher abstraction here is any callable
+returning a SampleMessage or raising StopIteration at epoch end (the
+server-client mode wires it to DistServer.fetch_one_sampled_message).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, List, Optional
+
+from .base import ChannelBase, SampleMessage
+from .shm import QueueTimeoutError
+
+
+class RemoteReceivingChannel(ChannelBase):
+  def __init__(self, fetch_fns: List[Callable[[], SampleMessage]],
+               prefetch_size: int = 4, capacity: int = 128):
+    self.fetch_fns = fetch_fns
+    self.prefetch_size = prefetch_size
+    self._out: 'queue.Queue' = queue.Queue(maxsize=capacity)
+    self._threads: List[threading.Thread] = []
+    self._live = 0
+    self._lock = threading.Lock()
+    self._started = False
+
+  def reset(self) -> None:
+    """Start a new epoch of pulling (reference per-epoch re-arm)."""
+    self._started = True
+    with self._lock:
+      self._live = len(self.fetch_fns)
+    self._threads = []
+    for fn in self.fetch_fns:
+      for _ in range(self.prefetch_size):
+        pass  # concurrency is per-thread; one puller per server
+      t = threading.Thread(target=self._pull_loop, args=(fn,),
+                           daemon=True)
+      t.start()
+      self._threads.append(t)
+
+  def _pull_loop(self, fn) -> None:
+    while True:
+      try:
+        msg = fn()
+      except StopIteration:
+        break
+      except Exception as e:  # surface errors to the consumer
+        self._out.put(e)
+        break
+      self._out.put(msg)
+    with self._lock:
+      self._live -= 1
+      if self._live == 0:
+        self._out.put(StopIteration())
+
+  def send(self, msg: SampleMessage) -> None:
+    raise RuntimeError('RemoteReceivingChannel is receive-only')
+
+  def recv(self, timeout_ms: int = 60_000) -> SampleMessage:
+    if not self._started:
+      self.reset()
+    try:
+      item = self._out.get(timeout=timeout_ms / 1000)
+    except queue.Empty as e:
+      raise QueueTimeoutError('remote recv timed out') from e
+    if isinstance(item, StopIteration):
+      self._started = False
+      raise StopIteration
+    if isinstance(item, Exception):
+      raise item
+    return item
+
+  def empty(self) -> bool:
+    return self._out.empty()

@@ -1,0 +1,128 @@
+"""ctypes bindings for the native shared-memory queue (csrc/shm_queue.cc)
+— the reference's pywrap.SampleQueue surface (py_export_glt.cc:127-146):
+picklable by shmid, blocking enqueue/dequeue with timeout.
+
+The library is built on demand with the checked-in Makefile (g++ only; no
+pybind11 in this image).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_LIB = None
+_LIB_LOCK = threading.Lock()
+_CSRC = os.path.join(os.path.dirname(__file__), '..', 'csrc')
+
+
+class QueueTimeoutError(Exception):
+  """Raised when a dequeue exceeds its timeout (reference
+  py_export_glt.cc:133-137 maps the same condition to this name)."""
+
+
+def _build_lib() -> str:
+  so = os.path.join(_CSRC, 'libglt_shm.so')
+  src = os.path.join(_CSRC, 'shm_queue.cc')
+  if (not os.path.exists(so)
+      or os.path.getmtime(so) < os.path.getmtime(src)):
+    subprocess.run(['make', '-C', _CSRC], check=True,
+                   capture_output=True)
+  return so
+
+
+def get_lib():
+  global _LIB
+  with _LIB_LOCK:
+    if _LIB is None:
+      lib = ctypes.CDLL(_build_lib())
+      lib.shmq_create.restype = ctypes.c_int
+      lib.shmq_create.argtypes = [ctypes.c_uint64]
+      lib.shmq_attach.restype = ctypes.c_void_p
+      lib.shmq_attach.argtypes = [ctypes.c_int]
+      lib.shmq_detach.argtypes = [ctypes.c_void_p]
+      lib.shmq_destroy.argtypes = [ctypes.c_int]
+      lib.shmq_enqueue.restype = ctypes.c_int
+      lib.shmq_enqueue.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_uint64, ctypes.c_int]
+      lib.shmq_peek_size.restype = ctypes.c_int64
+      lib.shmq_peek_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
+      lib.shmq_dequeue.restype = ctypes.c_int64
+      lib.shmq_dequeue.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                   ctypes.c_uint64, ctypes.c_int]
+      lib.shmq_size.restype = ctypes.c_uint64
+      lib.shmq_size.argtypes = [ctypes.c_void_p]
+      _LIB = lib
+    return _LIB
+
+
+class ShmQueue:
+  """Variable-block cross-process ring buffer.
+
+  Picklable: only the shmid travels; the receiving process re-attaches
+  (the ForkingPickler pattern of the reference, data/graph.py:257-306).
+  """
+
+  def __init__(self, capacity_bytes: int = 64 * 1024 * 1024,
+               shmid: int = None, owner: bool = True):
+    lib = get_lib()
+    if shmid is None:
+      shmid = lib.shmq_create(capacity_bytes)
+      if shmid < 0:
+        raise OSError(-shmid, 'shmq_create failed')
+      owner = True
+    self.shmid = shmid
+    self.owner = owner
+    self._handle = lib.shmq_attach(shmid)
+    if not self._handle:
+      raise OSError('shmq_attach failed')
+
+  def enqueue(self, data: bytes, timeout_ms: int = 60_000) -> None:
+    rc = get_lib().shmq_enqueue(self._handle, data, len(data),
+                                timeout_ms)
+    if rc == -110:  # -ETIMEDOUT
+      raise QueueTimeoutError('enqueue timed out')
+    if rc != 0:
+      raise OSError(-rc, 'shmq_enqueue failed')
+
+  def dequeue(self, timeout_ms: int = 60_000) -> bytes:
+    lib = get_lib()
+    size = lib.shmq_peek_size(self._handle, timeout_ms)
+    if size == -110:
+      raise QueueTimeoutError('dequeue timed out')
+    if size < 0:
+      raise OSError(int(-size), 'shmq_peek_size failed')
+    buf = ctypes.create_string_buffer(int(size))
+    got = lib.shmq_dequeue(self._handle, buf, int(size), timeout_ms)
+    if got == -110:
+      raise QueueTimeoutError('dequeue timed out')
+    if got < 0:
+      raise OSError(int(-got), 'shmq_dequeue failed')
+    return buf.raw[:got]
+
+  def size(self) -> int:
+    return int(get_lib().shmq_size(self._handle))
+
+  def empty(self) -> bool:
+    return self.size() == 0
+
+  def close(self) -> None:
+    if self._handle:
+      get_lib().shmq_detach(self._handle)
+      self._handle = None
+    if self.owner:
+      get_lib().shmq_destroy(self.shmid)
+      self.owner = False
+
+  # -- pickling by shmid -------------------------------------------------
+
+  def __reduce__(self):
+    return (ShmQueue, (0, self.shmid, False))
+
+  def __del__(self):
+    try:
+      if getattr(self, '_handle', None):
+        get_lib().shmq_detach(self._handle)
+    except Exception:
+      pass

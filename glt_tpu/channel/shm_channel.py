@@ -1,0 +1,33 @@
+"""ShmChannel — cross-process channel over the native shm ring buffer.
+
+Reference: graphlearn_torch/python/channel/shm_channel.py:24-53 (pywrap
+SampleQueue over csrc/shm_queue.cc). ``pin_memory`` has no TPU meaning
+(device transfer happens via device_put at the consumer); accepted for
+API parity and ignored.
+"""
+from __future__ import annotations
+
+from .base import ChannelBase, SampleMessage, pack_message, unpack_message
+from .shm import QueueTimeoutError, ShmQueue
+
+
+class ShmChannel(ChannelBase):
+  def __init__(self, capacity_bytes: int = 128 * 1024 * 1024,
+               pin_memory: bool = False, shm_queue: ShmQueue = None):
+    self._queue = shm_queue or ShmQueue(capacity_bytes)
+    del pin_memory  # API parity only
+
+  def send(self, msg: SampleMessage, timeout_ms: int = 60_000) -> None:
+    self._queue.enqueue(pack_message(msg), timeout_ms)
+
+  def recv(self, timeout_ms: int = 60_000) -> SampleMessage:
+    return unpack_message(self._queue.dequeue(timeout_ms))
+
+  def empty(self) -> bool:
+    return self._queue.empty()
+
+  def close(self) -> None:
+    self._queue.close()
+
+  def __reduce__(self):
+    return (ShmChannel, (0, False, self._queue))

@@ -78,7 +78,8 @@ class Topology:
       self.num_cols = int(num_cols) if num_cols is not None else (
           int(col.max()) + 1 if col.size else 0)
       self.indptr, self.indices, perm = _compress(
-          row, col, self.num_rows, index_dtype)
+          row, col, self.num_rows, index_dtype,
+          num_cols=self.num_cols if num_cols is not None else None)
       edge_ids = as_numpy(edge_ids)
       if edge_ids is not None:
         self.edge_ids = edge_ids[perm]
@@ -153,7 +154,7 @@ class Topology:
         index_dtype=self._index_dtype)
 
 
-def _compress(row, col, num_rows, index_dtype):
+def _compress(row, col, num_rows, index_dtype, num_cols=None):
   """COO -> compressed, sorting by (row, col); returns perm mapping
   compressed slot -> original COO position. indptr is int64 (overflow-safe
   for >= 2^31 edges)."""
@@ -162,6 +163,11 @@ def _compress(row, col, num_rows, index_dtype):
   if row.size and num_rows <= int(row.max()):
     raise ValueError(
         f'row id {int(row.max())} out of range for num_rows={num_rows}')
+  if num_cols is not None and col.size and num_cols <= int(col.max()):
+    # out-of-range neighbor ids would be silently dropped by the
+    # dense-table scatters downstream — fail loudly like the row side
+    raise ValueError(
+        f'col id {int(col.max())} out of range for num_cols={num_cols}')
   perm = np.lexsort((col, row))
   counts = np.bincount(row, minlength=num_rows)
   indptr = np.zeros(num_rows + 1, dtype=np.int64)

@@ -1,0 +1,44 @@
+from .dist_context import (
+    DistContext, DistRole, assign_server_by_order, get_context,
+    init_client_context, init_server_context, init_worker_group, shutdown,
+)
+from .dist_dataset import DistDataset
+from .dist_graph import DistGraph
+from .dist_feature import DistFeature
+from .dist_neighbor_sampler import DistNeighborSampler
+
+__all__ = [
+    'DistContext', 'DistRole', 'assign_server_by_order', 'get_context',
+    'init_client_context', 'init_server_context', 'init_worker_group',
+    'shutdown',
+    'DistDataset', 'DistGraph', 'DistFeature', 'DistNeighborSampler',
+]
+from .dist_train import DistTrainStep
+from .dist_loader import DistNeighborLoader
+
+__all__ += ['DistTrainStep', 'DistNeighborLoader']
+from .dist_options import (
+    CollocatedDistSamplingWorkerOptions, MpDistSamplingWorkerOptions,
+    RemoteDistSamplingWorkerOptions,
+)
+from .dist_sampling_producer import (
+    DistCollocatedSamplingProducer, DistMpSamplingProducer,
+)
+from .channel_loader import MpNeighborLoader, RemoteNeighborLoader
+from .dist_server import (
+    DistServer, init_server, shutdown_server, wait_and_shutdown_server,
+)
+from .dist_client import (
+    async_request_server, init_client, request_server, shutdown_client,
+)
+
+__all__ += [
+    'CollocatedDistSamplingWorkerOptions', 'MpDistSamplingWorkerOptions',
+    'RemoteDistSamplingWorkerOptions',
+    'DistCollocatedSamplingProducer', 'DistMpSamplingProducer',
+    'MpNeighborLoader', 'RemoteNeighborLoader',
+    'DistServer', 'init_server', 'shutdown_server',
+    'wait_and_shutdown_server',
+    'async_request_server', 'init_client', 'request_server',
+    'shutdown_client',
+]

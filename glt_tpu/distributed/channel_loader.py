@@ -1,0 +1,169 @@
+"""Channel-fed loaders: mp mode (local producer subprocesses) and remote
+mode (server-client).
+
+Reference: graphlearn_torch/python/distributed/dist_loader.py mode
+dispatch (:130-262): 'mp' spawns DistMpSamplingProducer + ShmChannel and
+consumes locally; 'remote' asks servers to create producers and consumes
+through RemoteReceivingChannel (:157-197). Both yield the same Batch
+pytrees as the inline loaders, so a training loop is mode-agnostic.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..channel import (
+    QueueTimeoutError, RemoteReceivingChannel, ShmChannel, pack_message,
+    unpack_message,
+)
+from ..channel.mp_channel import MpChannel
+from ..loader.transform import Batch
+from ..ops.pipeline import edge_hop_offsets
+from ..sampler.base import SamplingConfig
+from ..utils import as_numpy
+from .dist_options import (
+    MpDistSamplingWorkerOptions, RemoteDistSamplingWorkerOptions,
+)
+from .dist_sampling_producer import DistMpSamplingProducer, END_KEY
+
+
+def message_to_batch(msg, config: SamplingConfig,
+                     device=None) -> Batch:
+  """Flat SampleMessage -> Batch pytree (device_put here is the single
+  H2D transfer point, the reference's channel.recv + .to(device))."""
+  put = lambda a: (jax.device_put(jnp.asarray(a), device)
+                   if a is not None else None)
+  offs = edge_hop_offsets(config.batch_size, config.num_neighbors)
+  meta = {'n_valid': int(msg['n_valid'][0])} if 'n_valid' in msg else {}
+  return Batch(
+      x=put(msg.get('nfeats')),
+      y=put(msg.get('nlabels')),
+      row=put(msg['row']), col=put(msg['col']),
+      edge_mask=put(msg['edge_mask']),
+      node=put(msg['node']),
+      node_count=put(msg['node_count'][0]),
+      edge=put(msg.get('eids')),
+      num_sampled_nodes=put(msg.get('num_sampled_nodes')),
+      num_sampled_edges=put(msg.get('num_sampled_edges')),
+      metadata=meta,
+      batch_size=config.batch_size,
+      edge_hop_offsets=tuple(offs))
+
+
+class MpNeighborLoader:
+  """Mp-mode loader: CPU sampling subprocesses feed the training process
+  through the native shm ring (reference DistLoader mp branch)."""
+
+  def __init__(self, dataset_builder: Callable, num_neighbors,
+               input_nodes, batch_size: int = 512,
+               shuffle: bool = False, drop_last: bool = False,
+               with_edge: bool = False, collect_features: bool = True,
+               seed: Optional[int] = None,
+               worker_options: Optional[MpDistSamplingWorkerOptions]
+               = None, device=None):
+    self.options = worker_options or MpDistSamplingWorkerOptions()
+    self.config = SamplingConfig(
+        num_neighbors=list(num_neighbors), batch_size=batch_size,
+        shuffle=shuffle, drop_last=drop_last, with_edge=with_edge,
+        collect_features=collect_features, seed=seed)
+    if self.options.use_shm:
+      try:
+        self.channel = ShmChannel(
+            capacity_bytes=self.options.channel_capacity_bytes)
+      except Exception:
+        self.channel = MpChannel(capacity=256)
+    else:
+      self.channel = MpChannel(capacity=256)
+    self.producer = DistMpSamplingProducer(
+        dataset_builder, self.config, as_numpy(input_nodes),
+        self.channel, num_workers=self.options.num_workers)
+    self.producer.init()
+    self.device = device
+    self._epoch = 0
+
+  def __iter__(self):
+    self.producer.produce_all(self._epoch)
+    self._epoch += 1
+    ends = 0
+    while ends < self.producer.num_expected_ends:
+      msg = self.channel.recv(
+          timeout_ms=int(self.options.rpc_timeout * 1000))
+      if END_KEY in msg:
+        ends += 1
+        continue
+      yield message_to_batch(msg, self.config, self.device)
+
+  def shutdown(self) -> None:
+    self.producer.shutdown()
+    if hasattr(self.channel, 'close'):
+      self.channel.close()
+
+
+class RemoteNeighborLoader:
+  """Remote-mode loader: sampling runs inside server processes; batches
+  are pulled over rpc with prefetch (reference DistLoader remote branch
+  + RemoteReceivingChannel)."""
+
+  def __init__(self, num_neighbors, input_nodes_per_server,
+               batch_size: int = 512, shuffle: bool = False,
+               drop_last: bool = False, with_edge: bool = False,
+               collect_features: bool = True, seed: Optional[int] = None,
+               worker_options: Optional[RemoteDistSamplingWorkerOptions]
+               = None, num_workers_per_server: int = 1, device=None):
+    from . import dist_client
+    self.options = worker_options or RemoteDistSamplingWorkerOptions()
+    ranks = self.options.server_rank
+    if ranks is None:
+      ranks = list(range(len(input_nodes_per_server)))
+    if isinstance(ranks, int):
+      ranks = [ranks]
+    self.server_ranks = ranks
+    self.config = SamplingConfig(
+        num_neighbors=list(num_neighbors), batch_size=batch_size,
+        shuffle=shuffle, drop_last=drop_last, with_edge=with_edge,
+        collect_features=collect_features, seed=seed)
+    cfg_kwargs = dict(
+        num_neighbors=list(num_neighbors), batch_size=batch_size,
+        shuffle=shuffle, drop_last=drop_last, with_edge=with_edge,
+        collect_features=collect_features, seed=seed)
+    self.worker_key = (f'{self.options.worker_key}'
+                       f'@client{dist_client._client_rank}')
+    for rank, seeds in zip(ranks, input_nodes_per_server):
+      dist_client.request_server(
+          rank, 'create_sampling_producer', self.worker_key,
+          pack_message({'seeds': as_numpy(seeds).astype(np.int64)}),
+          cfg_kwargs, num_workers_per_server,
+          self.options.buffer_capacity_bytes)
+    self.device = device
+    self._epoch = 0
+
+    def make_fetcher(rank):
+      def fetch():
+        out = dist_client.request_server(
+            rank, 'fetch_one_sampled_message', self.worker_key)
+        if out == b'#EPOCH_END':
+          raise StopIteration
+        return unpack_message(out)
+      return fetch
+
+    self.channel = RemoteReceivingChannel(
+        [make_fetcher(r) for r in ranks],
+        prefetch_size=self.options.prefetch_size)
+
+  def __iter__(self):
+    from . import dist_client
+    for rank in self.server_ranks:
+      dist_client.request_server(rank, 'start_new_epoch_sampling',
+                                 self.worker_key, self._epoch)
+    self._epoch += 1
+    self.channel.reset()
+    while True:
+      try:
+        msg = self.channel.recv(
+            timeout_ms=int(self.options.rpc_timeout * 1000))
+      except StopIteration:
+        return
+      yield message_to_batch(msg, self.config, self.device)

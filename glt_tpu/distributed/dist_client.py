@@ -1,0 +1,61 @@
+"""Client side of server-client mode.
+
+Reference: graphlearn_torch/python/distributed/dist_client.py (101):
+init_client, request_server/async_request_server, and the ordered
+shutdown choreography (client barrier -> client 0 tells servers to exit
+-> teardown, :57-79).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .dist_context import get_context, init_client_context
+from .dist_server import server_port
+from .rpc import RpcClient
+
+_clients: Dict[int, RpcClient] = {}
+_num_servers = 0
+_client_rank = 0
+_num_clients = 0
+
+
+def init_client(num_servers: int, num_clients: int, client_rank: int,
+                master_addr: str = '127.0.0.1',
+                master_port: int = 29500) -> None:
+  global _num_servers, _client_rank, _num_clients
+  init_client_context(num_servers, num_clients, client_rank)
+  _num_servers = num_servers
+  _client_rank = client_rank
+  _num_clients = num_clients
+  for s in range(num_servers):
+    _clients[s] = RpcClient(master_addr, server_port(master_port, s))
+
+
+def request_server(server_rank: int, method: str, *args, **kwargs):
+  return _clients[server_rank].request(method, *args, **kwargs)
+
+
+def async_request_server(server_rank: int, method: str, *args, **kwargs):
+  return _clients[server_rank].async_request(method, *args, **kwargs)
+
+
+def barrier() -> None:
+  """Client-group barrier via server 0's built-in (reference rpc
+  role-scoped barrier)."""
+  request_server(0, '_barrier', f'clients', _num_clients)
+
+
+def shutdown_client() -> None:
+  """Ordered shutdown (reference dist_client.py:57-79)."""
+  if not _clients:
+    return
+  barrier()
+  if _client_rank == 0:
+    for s in range(_num_servers):
+      try:
+        request_server(s, 'exit')
+      except Exception:
+        pass
+  for c in _clients.values():
+    c.close()
+  _clients.clear()

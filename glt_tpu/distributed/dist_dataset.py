@@ -1,0 +1,126 @@
+"""DistDataset — a Dataset plus partition metadata.
+
+Reference: graphlearn_torch/python/distributed/dist_dataset.py:30-318.
+Holds the local partition's graph/features, the node/edge partition
+books, and the *feature* partition books (rewritten when hot-cache rows
+are concatenated in front, reference dist_dataset.py:85-181 +
+partition/base.py:866-907). ``load()`` reads the on-disk layout written
+by glt_tpu.partition.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Union
+
+import numpy as np
+
+from ..data import Dataset, Feature
+from ..partition import (
+    PartitionBook, cat_feature_cache, load_meta, load_partition,
+)
+from ..typing import EdgeType, GraphMode, NodeType
+from ..utils import as_numpy
+
+
+class DistDataset(Dataset):
+  def __init__(self,
+               num_partitions: int = 1,
+               partition_idx: int = 0,
+               graph=None, node_features=None, edge_features=None,
+               node_labels=None, edge_dir: str = 'out',
+               node_pb: Union[PartitionBook, Dict, None] = None,
+               edge_pb: Union[PartitionBook, Dict, None] = None,
+               node_feat_pb=None, edge_feat_pb=None):
+    super().__init__(graph, node_features, edge_features, node_labels,
+                     edge_dir)
+    self.num_partitions = int(num_partitions)
+    self.partition_idx = int(partition_idx)
+    self.node_pb = node_pb
+    self.edge_pb = edge_pb
+    #: feature PBs differ from graph PBs once hot rows are cached locally
+    self.node_feat_pb = node_feat_pb
+    self.edge_feat_pb = edge_feat_pb
+
+  def load(self, root_dir: str, partition_idx: int,
+           graph_mode: Union[str, GraphMode] = GraphMode.HBM,
+           feature_dtype=None,
+           whole_node_label_file: Optional[Union[str, Dict]] = None,
+           device=None) -> 'DistDataset':
+    """Load one partition from the on-disk layout (reference
+    dist_dataset.py:85-181): build the local Graph from this partition's
+    edges, concat cached features, and rewrite the feature PBs."""
+    meta, graph, nfeat, efeat, node_pb, edge_pb = load_partition(
+        root_dir, partition_idx)
+    self.num_partitions = meta['num_parts']
+    self.partition_idx = partition_idx
+    self.edge_dir = meta.get('edge_dir', self.edge_dir)
+    self.node_pb = node_pb
+    self.edge_pb = edge_pb
+
+    hetero = meta['data_cls'] == 'hetero'
+    if hetero:
+      edge_index = {e: g.edge_index for e, g in graph.items()}
+      edge_ids = {e: g.eids for e, g in graph.items()}
+      weights = {e: g.weights for e, g in graph.items()
+                 if g.weights is not None}
+      num_nodes = {nt: pb.table.shape[0] for nt, pb in node_pb.items()}
+      self.init_graph(edge_index=edge_index, edge_ids=edge_ids,
+                      edge_weights=weights or None, num_nodes=num_nodes,
+                      graph_mode=graph_mode, device=device)
+      if nfeat:
+        self.node_features = {}
+        self.node_feat_pb = {}
+        for nt, f in nfeat.items():
+          feats, ids, id2index, pb2 = cat_feature_cache(
+              partition_idx, f, node_pb[nt])
+          self.node_features[nt] = Feature(
+              feats, id2index=id2index, dtype=feature_dtype,
+              device=device)
+          self.node_feat_pb[nt] = pb2
+      if efeat:
+        self.edge_features = {}
+        self.edge_feat_pb = {}
+        for e, f in efeat.items():
+          feats, ids, id2index, pb2 = cat_feature_cache(
+              partition_idx, f, edge_pb[e])
+          self.edge_features[e] = Feature(
+              feats, id2index=id2index, dtype=feature_dtype,
+              device=device)
+          self.edge_feat_pb[e] = pb2
+    else:
+      self.init_graph(edge_index=graph.edge_index, edge_ids=graph.eids,
+                      edge_weights=graph.weights,
+                      num_nodes=node_pb.table.shape[0],
+                      graph_mode=graph_mode, device=device)
+      if nfeat is not None:
+        feats, ids, id2index, pb2 = cat_feature_cache(
+            partition_idx, nfeat, node_pb)
+        self.node_features = Feature(feats, id2index=id2index,
+                                     dtype=feature_dtype, device=device)
+        self.node_feat_pb = pb2
+      if efeat is not None:
+        feats, ids, id2index, pb2 = cat_feature_cache(
+            partition_idx, efeat, edge_pb)
+        self.edge_features = Feature(feats, id2index=id2index,
+                                     dtype=feature_dtype, device=device)
+        self.edge_feat_pb = pb2
+
+    if whole_node_label_file is not None:
+      if isinstance(whole_node_label_file, dict):
+        self.init_node_labels({nt: np.load(p) for nt, p
+                               in whole_node_label_file.items()})
+      else:
+        self.init_node_labels(np.load(whole_node_label_file))
+    return self
+
+  def get_node_feat_pb(self, ntype: Optional[NodeType] = None):
+    pb = self.node_feat_pb if self.node_feat_pb is not None \
+        else self.node_pb
+    if isinstance(pb, dict) and ntype is not None:
+      return pb[ntype]
+    return pb
+
+  def get_node_pb(self, ntype: Optional[NodeType] = None):
+    if isinstance(self.node_pb, dict) and ntype is not None:
+      return self.node_pb[ntype]
+    return self.node_pb

@@ -1,0 +1,133 @@
+"""DistFeature — partitioned feature store with collective lookup.
+
+Reference: graphlearn_torch/python/distributed/dist_feature.py:69-452.
+The design kept (per SURVEY.md §7) is the all2all path
+(dist_feature.py:270-366); the rpc path has no TPU analogue. Unlike
+parallel.ShardedFeature (uniform range sharding), this store follows an
+arbitrary *feature partition book* — including hot-cache rewrites where
+a remote row is also cached locally (cat_feature_cache,
+partition/base.py:866-907): the PB maps each id to a serving partition
+and the per-partition dense id2index maps it to the local row.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.collectives import all_to_all, bucket_by_owner, unbucket
+from ..partition import PartitionBook
+from ..utils import as_numpy
+from .dist_graph import _pb_dense
+
+
+class DistFeature:
+  """Stacked per-partition feature blocks, sharded over the mesh.
+
+  Args:
+    mesh: device mesh; axis size == number of partitions.
+    parts: per-partition (feats [R_p, D], id2index [N]) — id2index maps a
+      global id to its row in this partition's block (-1 if absent).
+    feat_pb: the feature partition book(s). Cache-rewritten PBs differ
+      per partition (each marks its own cached remote rows as local,
+      reference base.py:903-905), so this is a list of one PB per
+      partition (a single PB is broadcast); routing uses the
+      *requesting* device's book, exactly like the reference workers.
+    num_ids: global id-space size.
+  """
+
+  def __init__(self, mesh: Mesh, parts: Sequence, feat_pb,
+               num_ids: int, axis: str = 'data', dtype=None):
+    self.mesh = mesh
+    self.axis = axis
+    self.num_ids = int(num_ids)
+    n_parts = len(parts)
+    assert mesh.shape[axis] == n_parts
+    rows_max = max(max(f.shape[0] for f, _ in parts), 1)
+    self.feature_dim = parts[0][0].shape[1]
+    feats_l, maps_l = [], []
+    for feats, id2index in parts:
+      feats = as_numpy(feats)
+      if dtype is not None:
+        feats = feats.astype(dtype)
+      pad = rows_max - feats.shape[0]
+      if pad:
+        feats = np.concatenate(
+            [feats, np.zeros((pad, feats.shape[1]), feats.dtype)])
+      m = as_numpy(id2index).astype(np.int32)
+      if m.shape[0] < self.num_ids:
+        m = np.concatenate(
+            [m, np.full(self.num_ids - m.shape[0], -1, np.int32)])
+      feats_l.append(feats)
+      maps_l.append(m[:self.num_ids])
+    shard = NamedSharding(mesh, P(axis))
+    self.array = jax.device_put(np.stack(feats_l), shard)   # [P, R, D]
+    self.id2index = jax.device_put(np.stack(maps_l), shard)  # [P, N]
+    if not isinstance(feat_pb, (list, tuple)):
+      feat_pb = [feat_pb] * n_parts
+    self.feat_pb = jax.device_put(
+        np.stack([_pb_dense(pb, self.num_ids) for pb in feat_pb]),
+        shard)                                               # [P, N]
+    self.rows_max = rows_max
+    self.num_partitions = n_parts
+    # compiled once; rebuilding shard_map per call would re-trace
+    self._lookup_fn = jax.jit(jax.shard_map(
+        lambda f, m, pb, i, v: self.lookup_local(f[0], m[0], pb[0], i, v),
+        mesh=self.mesh,
+        in_specs=(P(self.axis), P(self.axis), P(self.axis), P(self.axis),
+                  P(self.axis)),
+        out_specs=P(self.axis), check_vma=False))
+
+  # -- in-shard lookup (call inside shard_map) ---------------------------
+
+  def lookup_local(self, feat_shard, map_shard, pb, ids, valid,
+                   axis_name: Optional[str] = None) -> jax.Array:
+    """feat_shard: [R, D] block; map_shard: [N]; pb: [N] — THIS device's
+    routing book; ids/valid: [B]. Returns [B, D] (zeros where invalid)."""
+    ax = axis_name or self.axis
+    n = self.num_partitions
+    owner = jnp.take(pb, jnp.clip(ids, 0, self.num_ids - 1), mode='clip')
+    owner = jnp.where(valid, owner, n)
+    req, meta = bucket_by_owner(ids, owner, n)
+    req_in = all_to_all(req, ax)                      # [P, B]
+    flat = req_in.reshape(-1)
+    rows = jnp.take(map_shard, jnp.clip(flat, 0, self.num_ids - 1),
+                    mode='clip')
+    ok = (flat >= 0) & (rows >= 0)
+    served = jnp.where(
+        ok[:, None],
+        jnp.take(feat_shard, jnp.clip(rows, 0, self.rows_max - 1),
+                 axis=0),
+        0)
+    resp = all_to_all(served.reshape(n, -1, self.feature_dim), ax)
+    return unbucket(resp, meta, n)
+
+  def lookup(self, ids, valid=None) -> jax.Array:
+    """Whole-mesh lookup: ids [P * B] shard-major."""
+    ids = jnp.asarray(as_numpy(ids), jnp.int32)
+    if valid is None:
+      valid = jnp.ones(ids.shape, bool)
+    return self._lookup_fn(self.array, self.id2index, self.feat_pb, ids,
+                           jnp.asarray(valid))
+
+  # -- builders ----------------------------------------------------------
+
+  @classmethod
+  def from_dist_datasets(cls, mesh: Mesh, datasets, ntype=None,
+                         axis: str = 'data', dtype=None):
+    """Single-host simulation: build from every partition's DistDataset
+    (features must be fully device-resident)."""
+    parts, pbs = [], []
+    num_ids = 0
+    for ds in datasets:
+      feat = (ds.node_features[ntype] if ntype is not None
+              else ds.node_features)
+      feat.lazy_init()
+      pb = ds.get_node_feat_pb(ntype)
+      pbs.append(pb)
+      num_ids = max(num_ids, pb.table.shape[0])
+      parts.append((np.asarray(feat.device_part), feat._id2index))
+    return cls(mesh, parts, pbs, num_ids, axis=axis, dtype=dtype)

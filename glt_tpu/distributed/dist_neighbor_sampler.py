@@ -1,0 +1,181 @@
+"""DistNeighborSampler — multi-hop sampling over sharded topology.
+
+Reference: graphlearn_torch/python/distributed/dist_neighbor_sampler.py
+(96-807): an asyncio engine that splits each hop's frontier by partition
+book, samples locally, RPCs remote partitions, and stitches
+(_sample_one_hop, :616-687). The TPU-native design collapses all of that
+into collectives (SURVEY.md §7 "One SPMD program instead of rpc actors"):
+
+    owner = node_pb[frontier]            # the PB routing
+    all_to_all(requests)                 # the rpc fan-out
+    local Pallas/XLA sample on each owner
+    all_to_all(responses)                # the rpc returns
+    positional unbucket                  # the stitch
+
+and the hop loop + dedup run unchanged from ops.pipeline — the same
+`multihop_sample` the single-device engine uses, with the one-hop
+function swapped for the collective version. No event loop, no
+concurrency semaphore: latency hiding is XLA's async collectives.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ops.pipeline import edge_hop_offsets, multihop_sample
+from ..ops.sample import sample_neighbors
+from ..ops.unique import dense_make_tables
+from ..parallel.collectives import all_to_all, bucket_by_owner, unbucket
+from ..sampler.base import SamplerOutput
+from ..utils import as_numpy
+from ..utils.rng import RandomSeedManager
+from .dist_graph import DistGraph
+
+
+def make_dist_one_hop(graph_shards: Dict[str, jax.Array], num_nodes: int,
+                      n_parts: int, rows_max: int, axis: str):
+  """Build the in-shard one-hop closure over sharded CSR blocks.
+
+  graph_shards: dict with this device's 'indptr' [R+1], 'indices' [E],
+  'edge_ids' [E], 'local_row' [N] and replicated 'node_pb' [N].
+  """
+  indptr = graph_shards['indptr']
+  indices = graph_shards['indices']
+  eids = graph_shards['edge_ids']
+  local_row = graph_shards['local_row']
+  node_pb = graph_shards['node_pb']
+
+  def one_hop(ids, fanout, key, mask):
+    f = ids.shape[0]
+    owner = jnp.take(node_pb, jnp.clip(ids, 0, num_nodes - 1),
+                     mode='clip')
+    owner = jnp.where(mask, owner, n_parts)
+    req, meta = bucket_by_owner(ids.astype(jnp.int32), owner, n_parts)
+    req_in = all_to_all(req, axis)                       # [P, F]
+    flat = req_in.reshape(-1)
+    lrow = jnp.take(local_row, jnp.clip(flat, 0, num_nodes - 1),
+                    mode='clip')
+    ok = (flat >= 0) & (lrow >= 0)
+    # every device serves with the same folded key stream: fold by the
+    # serving device so remote requests get independent randomness
+    serve_key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+    out = sample_neighbors(indptr, indices,
+                           jnp.clip(lrow, 0, rows_max - 1), fanout,
+                           serve_key, seed_mask=ok, edge_ids=eids)
+    resp_nbrs = all_to_all(out.nbrs.reshape(n_parts, f, fanout), axis)
+    resp_mask = all_to_all(out.mask.reshape(n_parts, f, fanout), axis)
+    resp_eids = all_to_all(out.eids.reshape(n_parts, f, fanout), axis)
+    nbrs = unbucket(resp_nbrs, meta, n_parts)
+    nmask = unbucket(resp_mask, meta, n_parts, invalid_value=False)
+    out_eids = unbucket(resp_eids, meta, n_parts, invalid_value=-1)
+    from ..ops.sample import NeighborOutput
+    return NeighborOutput(nbrs=nbrs, mask=nmask & mask[:, None],
+                          eids=out_eids)
+
+  return one_hop
+
+
+class DistNeighborSampler:
+  """Drives SPMD sampling over a DistGraph; one seed batch per device.
+
+  The jitted program takes [P * B] shard-major seeds and returns stacked
+  per-device SamplerOutput payloads [P, ...].
+  """
+
+  def __init__(self, dist_graph: DistGraph, num_neighbors: Sequence[int],
+               with_edge: bool = False, seed: Optional[int] = None):
+    self.g = dist_graph
+    self.num_neighbors = list(num_neighbors)
+    self.with_edge = with_edge
+    self.mesh = dist_graph.mesh
+    self.axis = dist_graph.axis
+    self._base_key = jax.random.key(
+        seed if seed is not None
+        else RandomSeedManager.getInstance().getSeed())
+    self._step = 0
+    self._fn_cache = {}
+    n_dev = self.mesh.shape[self.axis]
+    table, scratch = dense_make_tables(dist_graph.num_nodes)
+    shard = NamedSharding(self.mesh, P(self.axis))
+    self.tables = jax.device_put(
+        jnp.broadcast_to(table, (n_dev,) + table.shape), shard)
+    self.scratches = jax.device_put(
+        jnp.broadcast_to(scratch, (n_dev,) + scratch.shape), shard)
+
+  def _next_key(self):
+    self._step += 1
+    return jax.random.fold_in(self._base_key, self._step)
+
+  def _build(self, batch_size: int):
+    g = self.g
+    n_parts = g.num_partitions
+    axis = self.axis
+    fanouts = self.num_neighbors
+    with_edge = self.with_edge
+
+    def device_fn(indptr, indices, eids, local_row, node_pb, seeds,
+                  n_valid, key, table, scratch):
+      shards = dict(indptr=indptr[0], indices=indices[0],
+                    edge_ids=eids[0], local_row=local_row[0],
+                    node_pb=node_pb)
+      one_hop = make_dist_one_hop(shards, g.num_nodes, n_parts,
+                                  g.max_rows, axis)
+      my_key = jax.random.fold_in(key[0], jax.lax.axis_index(axis))
+      out, table_o, scratch_o = multihop_sample(
+          one_hop, seeds, n_valid[0], fanouts, my_key, table[0],
+          scratch[0], with_edge=with_edge)
+      out = {k: v[None] for k, v in out.items()}
+      return out, table_o[None], scratch_o[None]
+
+    sp = P(self.axis)
+    fn = jax.shard_map(
+        device_fn, mesh=self.mesh,
+        in_specs=(sp, sp, sp, sp, P(), sp, sp, sp, sp, sp),
+        out_specs=({k: sp for k in self._out_keys()}, sp, sp),
+        check_vma=False)
+
+    import functools
+    @functools.partial(jax.jit, donate_argnums=(3, 4))
+    def step(seeds, n_valid, keys, tables, scratches):
+      return fn(g.indptr, g.indices, g.edge_ids, g.local_row, g.node_pb,
+                seeds, n_valid, keys, tables, scratches)
+
+    return step
+
+  def _out_keys(self):
+    keys = ['node', 'node_count', 'row', 'col', 'edge_mask', 'batch',
+            'seed_labels', 'seed_count', 'num_sampled_nodes',
+            'num_sampled_edges']
+    if self.with_edge:
+      keys.append('edge')
+    return keys
+
+  def sample_from_nodes(self, seeds_per_device: np.ndarray,
+                        n_valid_per_device=None, key=None):
+    """seeds_per_device: [P, B] or [P*B] shard-major. Returns a dict of
+    stacked arrays [P, ...] (one SamplerOutput per device) plus updated
+    internal tables."""
+    seeds = as_numpy(seeds_per_device)
+    n_dev = self.mesh.shape[self.axis]
+    if seeds.ndim == 2:
+      seeds = seeds.reshape(-1)
+    batch_size = seeds.shape[0] // n_dev
+    if n_valid_per_device is None:
+      n_valid_per_device = np.full(n_dev, batch_size, np.int32)
+    if batch_size not in self._fn_cache:
+      self._fn_cache[batch_size] = self._build(batch_size)
+    if key is None:
+      key = self._next_key()
+    keys = jax.random.split(key, n_dev)
+    shard = NamedSharding(self.mesh, P(self.axis))
+    out, self.tables, self.scratches = self._fn_cache[batch_size](
+        jax.device_put(jnp.asarray(seeds, jnp.int32), shard),
+        jax.device_put(jnp.asarray(n_valid_per_device, jnp.int32), shard),
+        keys, self.tables, self.scratches)
+    out['edge_hop_offsets'] = edge_hop_offsets(batch_size, fanouts=
+                                               self.num_neighbors)
+    return out

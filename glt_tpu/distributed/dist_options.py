@@ -1,0 +1,45 @@
+"""Sampling worker options (reference distributed/dist_options.py:26-292).
+
+Three deployment modes:
+  * Collocated — sampling inline in the training process/program.
+  * Mp — N CPU sampling worker subprocesses streaming through the native
+    shm channel (the reference's spawn+shm design; on TPU this is the
+    host-CPU-samples / chip-trains split that hides sampling latency).
+  * Remote — sampling runs inside server processes (server-client mode).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Union
+
+
+@dataclasses.dataclass
+class _BasicDistSamplingWorkerOptions:
+  num_workers: int = 1
+  worker_concurrency: int = 4            # API parity; XLA pipelines instead
+  master_addr: Optional[str] = None
+  master_port: Optional[int] = None
+  rpc_timeout: float = 180.0
+
+
+@dataclasses.dataclass
+class CollocatedDistSamplingWorkerOptions(_BasicDistSamplingWorkerOptions):
+  """Reference dist_options.py:119-147."""
+  num_workers: int = 1
+
+
+@dataclasses.dataclass
+class MpDistSamplingWorkerOptions(_BasicDistSamplingWorkerOptions):
+  """Reference dist_options.py:149-208."""
+  channel_capacity_bytes: int = 256 * 1024 * 1024
+  pin_memory: bool = False               # parity; device_put at consumer
+  use_shm: bool = True                   # False -> mp.Queue fallback
+
+
+@dataclasses.dataclass
+class RemoteDistSamplingWorkerOptions(_BasicDistSamplingWorkerOptions):
+  """Reference dist_options.py:210-292."""
+  server_rank: Union[int, List[int], None] = None
+  buffer_capacity_bytes: int = 256 * 1024 * 1024
+  prefetch_size: int = 4
+  worker_key: str = 'default'

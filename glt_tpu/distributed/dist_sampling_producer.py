@@ -1,0 +1,177 @@
+"""Sampling producers: subprocess workers streaming sampled batches.
+
+Reference: graphlearn_torch/python/distributed/dist_sampling_producer.py
+(DistMpSamplingProducer:206-294 spawns N workers running
+_sampling_worker_loop:54-163, commands over a task queue, batches over
+the shm channel; DistCollocatedSamplingProducer:297-365 is the in-process
+variant). TPU translation: workers force the CPU jax backend (the chip
+belongs to the trainer) and stream flat SampleMessages through the native
+C++ shm ring; the consumer device_puts them. Epoch protocol: one
+``_END_MSG`` per worker closes the epoch, as the reference's epoch
+tracking does.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..channel import ChannelBase, SampleMessage
+from ..sampler.base import SamplingConfig
+from ..utils import as_numpy
+
+_SAMPLE_ALL = 'SAMPLE_ALL'
+_EXIT = 'EXIT'
+END_KEY = '#END'
+MP_STATUS_CHECK_INTERVAL = 5.0  # reference dist_sampling_producer.py:41-44
+
+
+def flatten_sampler_output(out, y=None, x=None) -> SampleMessage:
+  """SamplerOutput -> flat SampleMessage (the reference _colloate_fn keys,
+  dist_neighbor_sampler.py:689-807)."""
+  msg = {
+      'node': as_numpy(out.node),
+      'node_count': as_numpy(out.node_count).reshape(1),
+      'row': as_numpy(out.row),
+      'col': as_numpy(out.col),
+      'edge_mask': as_numpy(out.edge_mask),
+      'batch': as_numpy(out.batch),
+      'num_sampled_nodes': as_numpy(out.num_sampled_nodes),
+      'num_sampled_edges': as_numpy(out.num_sampled_edges),
+  }
+  if out.edge is not None:
+    msg['eids'] = as_numpy(out.edge)
+  if y is not None:
+    msg['nlabels'] = as_numpy(y)
+  if x is not None:
+    msg['nfeats'] = as_numpy(x)
+  return msg
+
+
+def _sampling_worker_loop(rank: int, num_workers: int,
+                          dataset_builder: Callable,
+                          config: SamplingConfig,
+                          seeds: np.ndarray,
+                          task_queue, channel: ChannelBase) -> None:
+  """Reference _sampling_worker_loop (dist_sampling_producer.py:54-163)."""
+  # the TPU chip belongs to the trainer; workers sample on host CPU
+  os.environ.setdefault('XLA_FLAGS', '')
+  import jax
+  try:
+    jax.config.update('jax_platforms', 'cpu')
+  except Exception:
+    pass
+  from ..loader import NodeLoader
+  from ..sampler import NeighborSampler
+
+  ds = dataset_builder()
+  sampler = NeighborSampler(
+      ds.graph, config.num_neighbors, with_edge=config.with_edge,
+      with_weight=config.with_weight, edge_dir=config.edge_dir,
+      seed=(config.seed or 0) + rank)
+  labels = ds.node_labels
+  feats = ds.node_features if config.collect_features else None
+
+  while True:
+    try:
+      cmd = task_queue.get(timeout=MP_STATUS_CHECK_INTERVAL)
+    except Exception:
+      continue
+    if cmd[0] == _EXIT:
+      break
+    epoch = cmd[1]
+    order = np.arange(seeds.shape[0])
+    if config.shuffle:
+      order = np.random.default_rng(epoch * num_workers + rank) \
+          .permutation(seeds.shape[0])
+    bs = config.batch_size
+    n = order.shape[0]
+    for lo in range(0, n, bs):
+      sel = order[lo:lo + bs]
+      if sel.shape[0] < bs:
+        if config.drop_last:
+          break
+        pad = np.full(bs - sel.shape[0], sel[-1] if sel.size else 0,
+                      sel.dtype)
+        sel = np.concatenate([sel, pad])
+      batch_seeds = seeds[sel]
+      n_valid = min(bs, n - lo)
+      out = sampler.sample_from_nodes(batch_seeds, n_valid=n_valid)
+      y = labels[batch_seeds] if labels is not None else None
+      x = None
+      if feats is not None:
+        x = feats[as_numpy(out.node).clip(min=0)]
+      msg = flatten_sampler_output(out, y=y, x=x)
+      msg['n_valid'] = np.array([n_valid], np.int32)
+      channel.send(msg)
+    channel.send({END_KEY: np.array([rank], np.int32)})
+
+
+class DistMpSamplingProducer:
+  """Spawn-based producer pool (reference :206-294)."""
+
+  def __init__(self, dataset_builder: Callable, config: SamplingConfig,
+               seeds, channel: ChannelBase, num_workers: int = 1):
+    self.dataset_builder = dataset_builder
+    self.config = config
+    self.seeds = as_numpy(seeds).astype(np.int64)
+    self.channel = channel
+    self.num_workers = int(num_workers)
+    self._ctx = mp.get_context('spawn')
+    self._task_queues = []
+    self._workers: List[mp.Process] = []
+
+  def init(self) -> None:
+    splits = np.array_split(self.seeds, self.num_workers)
+    for rank in range(self.num_workers):
+      tq = self._ctx.Queue()
+      w = self._ctx.Process(
+          target=_sampling_worker_loop,
+          args=(rank, self.num_workers, self.dataset_builder, self.config,
+                splits[rank], tq, self.channel),
+          daemon=True)
+      w.start()
+      self._task_queues.append(tq)
+      self._workers.append(w)
+
+  def produce_all(self, epoch: int = 0) -> None:
+    for tq in self._task_queues:
+      tq.put((_SAMPLE_ALL, epoch))
+
+  def shutdown(self) -> None:
+    for tq in self._task_queues:
+      try:
+        tq.put((_EXIT,))
+      except Exception:
+        pass
+    for w in self._workers:
+      w.join(timeout=10)
+      if w.is_alive():
+        w.terminate()
+    self._workers = []
+
+  @property
+  def num_expected_ends(self) -> int:
+    return self.num_workers
+
+
+class DistCollocatedSamplingProducer:
+  """Synchronous in-process producer (reference :297-365)."""
+
+  def __init__(self, dataset, config: SamplingConfig, seeds):
+    from ..sampler import NeighborSampler
+    self.config = config
+    self.seeds = as_numpy(seeds).astype(np.int64)
+    self.sampler = NeighborSampler(
+        dataset.graph, config.num_neighbors, with_edge=config.with_edge,
+        with_weight=config.with_weight, edge_dir=config.edge_dir,
+        seed=config.seed)
+    self.dataset = dataset
+
+  def sample_batch(self, batch_seeds: np.ndarray, n_valid: int):
+    out = self.sampler.sample_from_nodes(batch_seeds, n_valid=n_valid)
+    y = (self.dataset.node_labels[batch_seeds]
+         if self.dataset.node_labels is not None else None)
+    return out, y

@@ -1,0 +1,196 @@
+"""DistServer / server lifecycle — the sampling-service side of
+server-client mode.
+
+Reference: graphlearn_torch/python/distributed/dist_server.py (296):
+producer pool keyed by worker_key with per-producer buffers + epoch
+tracking (:50-211), PyG-remote-backend data-plane RPCs (:87-127), poll
+fetch (:193-210), lifecycle init_server/wait_and_shutdown_server
+(:224-281). Here servers are CPU sampling hosts (TPU clients train);
+the transport is glt_tpu.distributed.rpc, batches travel as packed
+TensorMap bytes.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..channel import (
+    QueueTimeoutError, ShmChannel, pack_message, unpack_message,
+)
+from ..channel.mp_channel import MpChannel
+from ..sampler.base import SamplingConfig, SamplingType
+from ..utils import as_numpy
+from .dist_context import init_server_context
+from .dist_sampling_producer import DistMpSamplingProducer, END_KEY
+from .rpc import RpcServer
+
+_END = b'#EPOCH_END'
+
+
+class DistServer:
+  """Reference dist_server.py:50-211."""
+
+  def __init__(self, dataset, dataset_builder=None):
+    self.dataset = dataset
+    self.dataset_builder = dataset_builder
+    self._producers: Dict[str, DistMpSamplingProducer] = {}
+    self._channels: Dict[str, object] = {}
+    self._ends_seen: Dict[str, int] = {}
+    self._exit = threading.Event()
+
+  # -- control plane -----------------------------------------------------
+
+  def get_dataset_meta(self):
+    ds = self.dataset
+    num_nodes = (None if ds.is_hetero else ds.get_graph().num_nodes)
+    return {
+        'num_partitions': getattr(ds, 'num_partitions', 1),
+        'partition_idx': getattr(ds, 'partition_idx', 0),
+        'is_hetero': ds.is_hetero,
+        'num_nodes': num_nodes,
+        'edge_dir': ds.edge_dir,
+    }
+
+  def create_sampling_producer(self, worker_key: str, seeds_bytes: bytes,
+                               config_kwargs: dict,
+                               num_workers: int = 1,
+                               buffer_capacity: int = 256 << 20) -> bool:
+    if worker_key in self._producers:
+      return True
+    assert self.dataset_builder is not None, (
+        'server needs a picklable dataset_builder to spawn sampling '
+        'workers')
+    seeds = unpack_message(seeds_bytes)['seeds']
+    config = SamplingConfig(**config_kwargs)
+    try:
+      channel = ShmChannel(capacity_bytes=buffer_capacity)
+    except Exception:
+      channel = MpChannel(capacity=256)
+    producer = DistMpSamplingProducer(
+        self.dataset_builder, config, seeds, channel,
+        num_workers=num_workers)
+    producer.init()
+    self._producers[worker_key] = producer
+    self._channels[worker_key] = channel
+    self._ends_seen[worker_key] = 0
+    return True
+
+  def start_new_epoch_sampling(self, worker_key: str, epoch: int) -> bool:
+    self._ends_seen[worker_key] = 0
+    self._producers[worker_key].produce_all(epoch)
+    return True
+
+  def fetch_one_sampled_message(self, worker_key: str,
+                                timeout_ms: int = 60_000) -> bytes:
+    """Returns packed SampleMessage bytes or the epoch-end marker once
+    every worker has finished (reference :193-210 poll loop)."""
+    producer = self._producers[worker_key]
+    channel = self._channels[worker_key]
+    deadline = time.time() + timeout_ms / 1000
+    while True:
+      remaining = max(int((deadline - time.time()) * 1000), 1)
+      msg = channel.recv(timeout_ms=remaining)
+      if END_KEY in msg:
+        self._ends_seen[worker_key] += 1
+        if self._ends_seen[worker_key] >= producer.num_expected_ends:
+          return _END
+        continue
+      return pack_message(msg)
+
+  # -- data plane (PyG remote backend, reference :87-127) ----------------
+
+  def get_node_feature(self, ids_bytes: bytes) -> bytes:
+    ids = unpack_message(ids_bytes)['ids']
+    feat = self.dataset.get_node_feature()
+    return pack_message({'feats': feat[ids]})
+
+  def get_node_label(self, ids_bytes: bytes) -> bytes:
+    ids = unpack_message(ids_bytes)['ids']
+    return pack_message(
+        {'labels': as_numpy(self.dataset.get_node_label())[ids]})
+
+  def get_tensor_size(self) -> tuple:
+    feat = self.dataset.get_node_feature()
+    return tuple(feat.shape)
+
+  def get_edge_index(self) -> bytes:
+    g = self.dataset.get_graph()
+    ptr, other, _ = g.topo.to_coo()
+    if g.layout == 'CSR':
+      ei = np.stack([ptr, other])
+    else:
+      ei = np.stack([other, ptr])
+    return pack_message({'edge_index': ei})
+
+  def get_edge_size(self) -> int:
+    return self.dataset.get_graph().num_edges
+
+  def get_node_partition_id(self, ids_bytes: bytes) -> bytes:
+    ids = unpack_message(ids_bytes)['ids']
+    pb = self.dataset.get_node_pb() if hasattr(self.dataset,
+                                               'get_node_pb') else None
+    if pb is None:
+      part = np.zeros(ids.shape[0], np.int32)
+    else:
+      part = pb[ids]
+    return pack_message({'partition': part})
+
+  # -- lifecycle ---------------------------------------------------------
+
+  def exit(self) -> bool:
+    for producer in self._producers.values():
+      producer.shutdown()
+    self._producers.clear()
+    self._exit.set()
+    return True
+
+  @property
+  def should_exit(self) -> bool:
+    return self._exit.is_set()
+
+
+_server: Optional[DistServer] = None
+_rpc_server: Optional[RpcServer] = None
+
+
+def server_port(master_port: int, server_rank: int) -> int:
+  return master_port + server_rank
+
+
+def init_server(num_servers: int, num_clients: int, server_rank: int,
+                dataset, master_addr: str = '127.0.0.1',
+                master_port: int = 29500, dataset_builder=None
+                ) -> DistServer:
+  """Reference dist_server.py:224-260: bind the rpc endpoint (port =
+  master_port + rank by convention) and expose the DistServer surface."""
+  global _server, _rpc_server
+  init_server_context(num_servers, num_clients, server_rank)
+  _server = DistServer(dataset, dataset_builder)
+  _rpc_server = RpcServer(master_addr,
+                          server_port(master_port, server_rank))
+  for name in ('get_dataset_meta', 'create_sampling_producer',
+               'start_new_epoch_sampling', 'fetch_one_sampled_message',
+               'get_node_feature', 'get_node_label', 'get_tensor_size',
+               'get_edge_index', 'get_edge_size',
+               'get_node_partition_id', 'exit'):
+    _rpc_server.register(name, getattr(_server, name))
+  return _server
+
+
+def wait_and_shutdown_server(poll_s: float = 0.2) -> None:
+  """Reference :263-281 poll loop."""
+  assert _server is not None
+  while not _server.should_exit:
+    time.sleep(poll_s)
+  shutdown_server()
+
+
+def shutdown_server() -> None:
+  global _server, _rpc_server
+  if _rpc_server is not None:
+    _rpc_server.stop()
+  _server = None
+  _rpc_server = None

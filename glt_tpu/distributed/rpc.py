@@ -1,0 +1,159 @@
+"""Minimal socket RPC fabric for server-client mode.
+
+Reference: graphlearn_torch/python/distributed/rpc.py (529 lines over
+torch.distributed.rpc/TensorPipe: callee registry, role-scoped
+all_gather/barrier, request wrappers). The TPU build needs RPC only for
+the *server-client control/data plane* (worker-mode exchanges ride XLA
+collectives instead, SURVEY.md §2.3), so this is a deliberately small
+length-prefixed-pickle protocol over TCP: a threaded RpcServer with a
+callee registry plus built-in barrier/gather used by the client shutdown
+choreography. Payload tensors travel as the channel's packed TensorMap
+bytes, not pickled arrays.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional
+
+_HDR = struct.Struct('<Q')
+
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+  data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+  sock.sendall(_HDR.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+  buf = b''
+  while len(buf) < n:
+    chunk = sock.recv(n - len(buf))
+    if not chunk:
+      raise ConnectionError('peer closed')
+    buf += chunk
+  return buf
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+  (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+  return pickle.loads(_recv_exact(sock, n))
+
+
+class RpcServer:
+  """Threaded RPC endpoint with a callee registry
+  (the RpcCalleeBase/rpc_register pattern, reference rpc.py:419-473)."""
+
+  def __init__(self, host: str = '127.0.0.1', port: int = 0):
+    self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    self._sock.bind((host, port))
+    self._sock.listen(64)
+    self.host, self.port = self._sock.getsockname()
+    self._callees: Dict[str, Callable] = {}
+    self._threads: List[threading.Thread] = []
+    self._stop = threading.Event()
+    self._barriers: Dict[str, threading.Barrier] = {}
+    self._gathers: Dict[str, dict] = {}
+    self._lock = threading.Lock()
+    self.register('_barrier', self._barrier)
+    self.register('_gather', self._gather)
+    self._accept_thread = threading.Thread(target=self._accept_loop,
+                                           daemon=True)
+    self._accept_thread.start()
+
+  def register(self, name: str, fn: Callable) -> None:
+    self._callees[name] = fn
+
+  # built-in synchronization callees (reference rpc.py:105-235)
+  def _barrier(self, key: str, world: int) -> bool:
+    with self._lock:
+      if key not in self._barriers:
+        self._barriers[key] = threading.Barrier(world)
+      b = self._barriers[key]
+    b.wait(timeout=180)
+    return True
+
+  def _gather(self, key: str, rank: int, world: int, value) -> dict:
+    with self._lock:
+      slot = self._gathers.setdefault(
+          key, {'vals': {}, 'cond': threading.Condition(self._lock)})
+      slot['vals'][rank] = value
+      slot['cond'].notify_all()
+      while len(slot['vals']) < world:
+        if not slot['cond'].wait(timeout=180):
+          raise TimeoutError(f'gather {key} timed out')
+      return dict(slot['vals'])
+
+  def _accept_loop(self) -> None:
+    while not self._stop.is_set():
+      try:
+        conn, _ = self._sock.accept()
+      except OSError:
+        break
+      t = threading.Thread(target=self._serve_conn, args=(conn,),
+                           daemon=True)
+      t.start()
+      self._threads.append(t)
+
+  def _serve_conn(self, conn: socket.socket) -> None:
+    with conn:
+      while not self._stop.is_set():
+        try:
+          name, args, kwargs = _recv_msg(conn)
+        except (ConnectionError, EOFError, OSError):
+          return
+        try:
+          fn = self._callees[name]
+          _send_msg(conn, ('ok', fn(*args, **kwargs)))
+        except BaseException as e:  # deliver errors to the caller
+          try:
+            _send_msg(conn, ('err', e))
+          except Exception:
+            _send_msg(conn, ('err', RuntimeError(str(e))))
+
+  def stop(self) -> None:
+    self._stop.set()
+    try:
+      self._sock.close()
+    except OSError:
+      pass
+
+
+class RpcClient:
+  """One connection per (client, server); thread-safe; async via a pool
+  (the reference's async_request_server, dist_client.py:82-101)."""
+
+  _pool = ThreadPoolExecutor(max_workers=16)
+
+  def __init__(self, host: str, port: int, timeout: float = 180.0):
+    self._addr = (host, port)
+    self._timeout = timeout
+    self._lock = threading.Lock()
+    self._sock = None
+    self._connect()
+
+  def _connect(self) -> None:
+    self._sock = socket.create_connection(self._addr,
+                                          timeout=self._timeout)
+
+  def request(self, name: str, *args, **kwargs):
+    with self._lock:
+      _send_msg(self._sock, (name, args, kwargs))
+      status, payload = _recv_msg(self._sock)
+    if status == 'err':
+      raise payload
+    return payload
+
+  def async_request(self, name: str, *args, **kwargs) -> Future:
+    return self._pool.submit(self.request, name, *args, **kwargs)
+
+  def close(self) -> None:
+    with self._lock:
+      if self._sock is not None:
+        try:
+          self._sock.close()
+        finally:
+          self._sock = None

@@ -62,9 +62,13 @@ class LinkLoader(NodeLoader):
     if isinstance(neg_sampling, dict):
       neg_sampling = NegativeSampling(**neg_sampling)
     self.neg_sampling = neg_sampling
+    input_type = self.input_type
     super().__init__(data, sampler, input_nodes=np.arange(
         self.edge_rows.shape[0]), batch_size=batch_size, shuffle=shuffle,
         drop_last=drop_last, collect_features=collect_features, rng=rng)
+    # NodeLoader.__init__ resets input_type (its seeds are node ids, ours
+    # are edge positions) — restore the edge type
+    self.input_type = input_type
 
   def _make_batch(self, seed_idx: np.ndarray, n_valid: int):
     rows = self.edge_rows[seed_idx]

@@ -1,0 +1,69 @@
+"""Bucket-exchange-unbucket: the SPMD request/response pattern.
+
+This is the TPU-native replacement for the reference's cross-partition
+RPC fan-out (dist_neighbor_sampler.py:616-687: split ids by partition
+book -> rpc to owners -> stitch): requests are packed into fixed-capacity
+per-owner buckets, exchanged with one all_to_all over ICI, served
+locally, and sent back with a second all_to_all; the un-bucketing scatter
+is the positional stitch (stitch_sample_results.cu analog). All shapes
+static; worst-case capacity = the full request vector per peer.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class BucketMeta(NamedTuple):
+  order: jax.Array         # argsort of owner (stable)
+  owner_sorted: jax.Array  # [B]
+  pos_in_bucket: jax.Array  # [B]
+
+
+def bucket_by_owner(ids: jax.Array, owner: jax.Array, n_shards: int,
+                    fill_value=-1):
+  """Pack ids into per-owner buckets [n_shards, B].
+
+  ``owner`` must be in [0, n_shards) for valid entries and == n_shards
+  for invalid/padded ones (they are dropped). Bucket slots beyond each
+  owner's request count hold ``fill_value``.
+  """
+  b = ids.shape[0]
+  order = jnp.argsort(owner, stable=True)
+  ids_sorted = jnp.take(ids, order)
+  owner_sorted = jnp.take(owner, order)
+  counts = jnp.bincount(jnp.minimum(owner_sorted, n_shards),
+                        length=n_shards + 1)[:n_shards]
+  offsets = jnp.cumsum(counts) - counts
+  pos = jnp.arange(b) - jnp.take(
+      offsets, jnp.minimum(owner_sorted, n_shards - 1))
+  ok = owner_sorted < n_shards
+  buckets = jnp.full((n_shards + 1, b), fill_value, ids.dtype)
+  buckets = buckets.at[
+      jnp.where(ok, owner_sorted, n_shards),
+      jnp.where(ok, pos, 0)].set(jnp.where(ok, ids_sorted, fill_value))
+  return buckets[:n_shards], BucketMeta(order, owner_sorted, pos)
+
+
+def unbucket(resp: jax.Array, meta: BucketMeta, n_shards: int,
+             invalid_value=0) -> jax.Array:
+  """Invert bucket_by_owner over a response [n_shards, B, ...]: returns
+  [B, ...] in the original request order; dropped slots get
+  ``invalid_value``."""
+  ok = meta.owner_sorted < n_shards
+  gathered = resp[jnp.minimum(meta.owner_sorted, n_shards - 1),
+                  meta.pos_in_bucket]
+  shape = (ok.shape[0],) + (1,) * (gathered.ndim - 1)
+  gathered = jnp.where(ok.reshape(shape), gathered, invalid_value)
+  out = jnp.zeros_like(gathered)
+  return out.at[meta.order].set(gathered)
+
+
+def all_to_all(x: jax.Array, axis_name: str) -> jax.Array:
+  """Exchange row p of x with peer p along ``axis_name``; x: [P, ...]."""
+  n = x.shape[0]
+  y = jax.lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
+                         tiled=False)
+  return y.reshape((n,) + x.shape[1:])

@@ -51,6 +51,12 @@ class ShardedFeature:
     self.feature_dim = feats.shape[1]
     self.array = jax.device_put(
         feats, NamedSharding(mesh, P(axis)))
+    # compiled once; rebuilding shard_map per call would re-trace
+    self._lookup_fn = jax.jit(jax.shard_map(
+        lambda shard, i, v: self.lookup_local(shard, i, v),
+        mesh=self.mesh,
+        in_specs=(P(self.axis), P(self.axis), P(self.axis)),
+        out_specs=P(self.axis), check_vma=False))
 
   # -- in-shard lookup ---------------------------------------------------
 
@@ -121,9 +127,4 @@ class ShardedFeature:
       valid = jnp.ones(ids.shape, bool)
     n_shards = self.mesh.shape[self.axis]
     assert ids.shape[0] % n_shards == 0
-    fn = jax.shard_map(
-        lambda shard, i, v: self.lookup_local(shard, i, v),
-        mesh=self.mesh,
-        in_specs=(P(self.axis), P(self.axis), P(self.axis)),
-        out_specs=P(self.axis), check_vma=False)
-    return fn(self.array, ids, valid)
+    return self._lookup_fn(self.array, ids, valid)

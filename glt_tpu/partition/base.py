@@ -159,8 +159,7 @@ class PartitionerBase:
       payload = dict(rows=ei[0, eids], cols=ei[1, eids], eids=eids)
       if w is not None:
         payload['weights'] = w[eids]
-      d = os.path.join(self.output_dir, f'part{p}',
-                       'graph' if ename is None else 'graph')
+      d = os.path.join(self.output_dir, f'part{p}', 'graph')
       os.makedirs(d, exist_ok=True)
       fname = (os.path.join(d, f'{ename}.npz') if ename
                else os.path.join(d, 'data.npz'))

@@ -54,30 +54,41 @@ class FrequencyPartitioner(PartitionerBase):
     pb = np.full(n, -1, dtype=np.int32)
     capacity = int(np.ceil(n / num_parts))
     sizes = np.zeros(num_parts, dtype=np.int64)
-    # greedy chunked assignment by hotness gap (reference
+    # greedy chunked assignment by hotness (reference
     # frequency_partitioner.py:123-171): nodes go to the partition that
-    # wants them most, subject to balance capacity
+    # wants them most, subject to balance capacity. Fully vectorized:
+    # per preference rank, each partition takes its hottest still-free
+    # candidates up to remaining capacity.
     for lo in range(0, n, self.chunk_size):
       hi = min(lo + self.chunk_size, n)
+      c = hi - lo
       chunk = probs[:, lo:hi]               # [P, C]
       order = np.argsort(-chunk, axis=0)    # partitions by desire
-      # iterate preference ranks; assign where capacity remains
-      assigned = np.full(hi - lo, False)
+      assigned = np.zeros(c, dtype=bool)
       for rank in range(num_parts):
-        pref = order[rank]
-        for j in np.argsort(-chunk[pref, np.arange(hi - lo)]):
-          if assigned[j]:
+        pref = order[rank]                  # [C] preferred partition
+        for p in range(num_parts):
+          room = capacity - sizes[p]
+          if room <= 0:
             continue
-          p = pref[j]
-          if sizes[p] < capacity:
-            pb[lo + j] = p
-            sizes[p] += 1
-            assigned[j] = True
-      # leftovers -> least-loaded
-      for j in np.nonzero(~assigned)[0]:
-        p = int(np.argmin(sizes))
-        pb[lo + j] = p
-        sizes[p] += 1
+          cand = np.nonzero((pref == p) & ~assigned)[0]
+          if cand.size == 0:
+            continue
+          take = cand[np.argsort(-chunk[p, cand], kind='stable')[:room]]
+          pb[lo + take] = p
+          assigned[take] = True
+          sizes[p] += take.shape[0]
+      left = np.nonzero(~assigned)[0]
+      if left.size:
+        # spread leftovers into spare capacity, least-loaded first
+        spare = np.maximum(capacity - sizes, 0)
+        while spare.sum() < left.size:       # all full: grow evenly
+          spare += 1
+        targets = np.repeat(np.argsort(sizes, kind='stable'),
+                            spare[np.argsort(sizes, kind='stable')])
+        targets = targets[:left.size].astype(np.int32)
+        pb[lo + left] = targets
+        np.add.at(sizes, targets, 1)
     self._pb_cache[ntype] = pb
     return pb
 

@@ -408,7 +408,9 @@ class NeighborSampler(BaseSampler):
     neg = inputs.neg_sampling
     num_pos = src.shape[0]
     num_neg = 0
-    key = kwargs.get('key', self._next_key())
+    key = kwargs.pop('key', None)
+    if key is None:
+      key = self._next_key()
 
     if neg is not None:
       num_neg = neg.sample_size(num_pos)

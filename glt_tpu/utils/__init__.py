@@ -9,3 +9,5 @@ __all__ = [
     'seed_everything', 'merge_dict', 'parse_size',
     'RandomSeedManager', 'new_key',
 ]
+from . import profile  # noqa: F401
+from . import checkpoint  # noqa: F401

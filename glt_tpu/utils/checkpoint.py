@@ -1,0 +1,53 @@
+"""Training checkpoint/resume via orbax.
+
+The reference leaves checkpointing to examples (torch.save of model
+state, examples/igbh/dist_train_rgnn.py:190-213 with ckpt_steps); here
+it is a first-class utility: save/restore (params, opt_state, step) with
+retention, usable from any training loop.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+def _manager(ckpt_dir: str, max_to_keep: int = 3):
+  import orbax.checkpoint as ocp
+  options = ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                         create=True)
+  return ocp.CheckpointManager(os.path.abspath(ckpt_dir),
+                               options=options)
+
+
+def save_checkpoint(ckpt_dir: str, step: int, params: Any,
+                    opt_state: Any = None, extra: Any = None,
+                    max_to_keep: int = 3) -> None:
+  import orbax.checkpoint as ocp
+  mgr = _manager(ckpt_dir, max_to_keep)
+  payload = {'params': params}
+  if opt_state is not None:
+    payload['opt_state'] = opt_state
+  if extra is not None:
+    payload['extra'] = extra
+  mgr.save(step, args=ocp.args.StandardSave(payload))
+  mgr.wait_until_finished()
+  mgr.close()
+
+
+def restore_checkpoint(ckpt_dir: str, step: Optional[int] = None,
+                       template: Any = None):
+  """Returns (step, payload dict). ``template`` (a matching pytree of
+  arrays) restores with correct shardings/dtypes when given."""
+  import orbax.checkpoint as ocp
+  mgr = _manager(ckpt_dir)
+  step = mgr.latest_step() if step is None else step
+  if step is None:
+    return None, None
+  if template is not None:
+    out = mgr.restore(step, args=ocp.args.StandardRestore(template))
+  else:
+    out = mgr.restore(step)
+  mgr.close()
+  return step, out

@@ -1,0 +1,80 @@
+"""Profiling / throughput instrumentation.
+
+The reference measures throughput with manual time.time() +
+cuda.synchronize in bench scripts (SURVEY.md §5.1) and has no built-in
+tracer. Here timing hooks are first-class: a ThroughputMeter for the
+sampled-edges/sec north-star metric, a device-synchronizing Timer, and a
+context manager around the XLA profiler for real traces.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+import jax
+
+
+class Timer:
+  """Wall-clock timer that synchronizes outstanding device work."""
+
+  def __init__(self):
+    self.reset()
+
+  def reset(self):
+    self._t0 = None
+    self.elapsed = 0.0
+
+  def start(self):
+    self._t0 = time.perf_counter()
+    return self
+
+  def stop(self, sync: Optional[jax.Array] = None) -> float:
+    if sync is not None:
+      jax.block_until_ready(sync)
+    self.elapsed += time.perf_counter() - self._t0
+    return self.elapsed
+
+  def __enter__(self):
+    return self.start()
+
+  def __exit__(self, *exc):
+    self.stop()
+
+
+class ThroughputMeter:
+  """Accumulates (count, seconds) and reports rate — the
+  'Sampled Edges per secs' metric (benchmarks/api/bench_sampler.py)."""
+
+  def __init__(self, unit: str = 'edges'):
+    self.unit = unit
+    self.count = 0
+    self.seconds = 0.0
+
+  def update(self, count: int, seconds: float):
+    self.count += int(count)
+    self.seconds += seconds
+
+  @property
+  def rate(self) -> float:
+    return self.count / self.seconds if self.seconds > 0 else 0.0
+
+  def report(self) -> str:
+    return f'{self.rate / 1e6:.2f}M {self.unit}/s'
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+  """XLA profiler trace (view with tensorboard / xprof)."""
+  jax.profiler.start_trace(log_dir)
+  try:
+    yield
+  finally:
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+  """Named region inside a trace."""
+  with jax.profiler.TraceAnnotation(name):
+    yield

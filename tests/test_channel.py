@@ -1,0 +1,134 @@
+"""Channel layer tests: native shm queue (C++ ring buffer), TensorMap
+wire format, cross-process transfer (the reference's test_shm_channel /
+test_tensor_map_serializer coverage)."""
+import multiprocessing as mp
+import numpy as np
+import pytest
+
+from glt_tpu.channel import (
+    QueueTimeoutError, ShmChannel, ShmQueue, pack_message, unpack_message,
+)
+
+
+def test_pack_unpack_roundtrip():
+  msg = {
+      'ids': np.arange(10, dtype=np.int64),
+      'feats': np.random.default_rng(0).normal(size=(4, 3)).astype(
+          np.float32),
+      'mask': np.array([True, False, True]),
+      'scalar': np.float32(3.5).reshape(()),
+  }
+  out = unpack_message(pack_message(msg))
+  assert set(out) == set(msg)
+  for k in msg:
+    np.testing.assert_array_equal(np.asarray(out[k]), np.asarray(msg[k]))
+    assert out[k].dtype == np.asarray(msg[k]).dtype
+
+
+def test_pack_unpack_bf16():
+  import ml_dtypes
+  msg = {'x': np.arange(6, dtype=np.float32).astype(
+      ml_dtypes.bfloat16).reshape(2, 3)}
+  out = unpack_message(pack_message(msg))
+  assert out['x'].dtype.name == 'bfloat16'
+  np.testing.assert_array_equal(
+      out['x'].astype(np.float32), np.arange(6, np.float32).reshape(2, 3)
+      if False else np.arange(6, dtype=np.float32).reshape(2, 3))
+
+
+def test_shm_queue_fifo_and_wraparound():
+  q = ShmQueue(capacity_bytes=1 << 12)  # tiny: forces wraparound
+  try:
+    rng = np.random.default_rng(0)
+    payloads = [rng.bytes(rng.integers(1, 800)) for _ in range(64)]
+    # interleave: stay under capacity while forcing the ring to wrap
+    for i in range(0, 64, 4):
+      for p in payloads[i:i + 4]:
+        q.enqueue(p)
+      for p in payloads[i:i + 4]:
+        assert q.dequeue() == p
+    assert q.empty()
+  finally:
+    q.close()
+
+
+def test_shm_queue_timeout():
+  q = ShmQueue(capacity_bytes=1 << 12)
+  try:
+    with pytest.raises(QueueTimeoutError):
+      q.dequeue(timeout_ms=50)
+  finally:
+    q.close()
+
+
+def test_shm_queue_oversized_message():
+  q = ShmQueue(capacity_bytes=1 << 10)
+  try:
+    with pytest.raises(OSError):
+      q.enqueue(b'x' * 5000)
+  finally:
+    q.close()
+
+
+def _producer_proc(chan, n):
+  for i in range(n):
+    chan.send({'i': np.array([i]), 'payload': np.full((8,), i,
+                                                      np.float32)})
+
+
+def test_shm_channel_cross_process():
+  chan = ShmChannel(capacity_bytes=1 << 20)
+  try:
+    ctx = mp.get_context('spawn')
+    p = ctx.Process(target=_producer_proc, args=(chan, 20))
+    p.start()
+    got = [chan.recv(timeout_ms=30_000) for _ in range(20)]
+    p.join(timeout=30)
+    assert p.exitcode == 0
+    for i, msg in enumerate(got):
+      assert int(msg['i'][0]) == i
+      np.testing.assert_allclose(msg['payload'], i)
+  finally:
+    chan.close()
+
+
+def test_shm_channel_blocking_backpressure():
+  # producer blocks when the ring is full, resumes as consumer drains
+  chan = ShmChannel(capacity_bytes=1 << 12)
+  try:
+    ctx = mp.get_context('spawn')
+    p = ctx.Process(target=_producer_proc, args=(chan, 200))
+    p.start()
+    seen = 0
+    for _ in range(200):
+      msg = chan.recv(timeout_ms=30_000)
+      seen += 1
+    p.join(timeout=30)
+    assert seen == 200 and p.exitcode == 0
+  finally:
+    chan.close()
+
+
+def test_remote_receiving_channel():
+  from glt_tpu.channel import RemoteReceivingChannel
+  def make_fetcher(server_id, n=5):
+    state = {'i': 0}
+    def fetch():
+      if state['i'] >= n:
+        raise StopIteration
+      i = state['i']; state['i'] += 1
+      return {'sid': np.array([server_id]), 'i': np.array([i])}
+    return fetch
+  ch = RemoteReceivingChannel([make_fetcher(0), make_fetcher(1)],
+                              prefetch_size=2)
+  got = []
+  while True:
+    try:
+      got.append(ch.recv(timeout_ms=10_000))
+    except StopIteration:
+      break
+  assert len(got) == 10
+  per = {0: [], 1: []}
+  for m in got:
+    per[int(m['sid'][0])].append(int(m['i'][0]))
+  assert per[0] == list(range(5)) and per[1] == list(range(5))
