@@ -1,0 +1,97 @@
+"""TableDataset — build datasets from tabular edge/node sources.
+
+Reference: graphlearn_torch/python/data/table_dataset.py (PAI/ODPS
+tables via common_io readers) and distributed/dist_table_dataset.py. The
+ODPS service is Alibaba-cloud-specific; the capability kept here is the
+*reader protocol*: any iterable yielding (ids..., payload) record chunks
+can feed a Dataset — plug in ODPS readers where available, CSV/npz
+readers elsewhere.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional, Sequence
+
+import numpy as np
+
+from ..utils import as_numpy
+from .dataset import Dataset
+
+#: a reader yields chunks: edge readers -> (src_ids, dst_ids[, weights]);
+#: node readers -> (node_ids, feature_rows[, labels])
+TableReader = Iterable
+
+
+class TableDataset(Dataset):
+  """Assembles a Dataset by streaming table readers (reference
+  table_dataset.py:30-100)."""
+
+  def load(self,
+           edge_reader: Optional[TableReader] = None,
+           node_reader: Optional[TableReader] = None,
+           num_nodes: Optional[int] = None,
+           directed: bool = True,
+           graph_mode='HBM') -> 'TableDataset':
+    srcs, dsts, weights = [], [], []
+    if edge_reader is not None:
+      for rec in edge_reader:
+        srcs.append(as_numpy(rec[0]).astype(np.int64))
+        dsts.append(as_numpy(rec[1]).astype(np.int64))
+        if len(rec) > 2 and rec[2] is not None:
+          weights.append(as_numpy(rec[2]).astype(np.float32))
+    ids_l, feats_l, labels_l = [], [], []
+    if node_reader is not None:
+      for rec in node_reader:
+        ids_l.append(as_numpy(rec[0]).astype(np.int64))
+        feats_l.append(as_numpy(rec[1]))
+        if len(rec) > 2 and rec[2] is not None:
+          labels_l.append(as_numpy(rec[2]))
+
+    if srcs:
+      src = np.concatenate(srcs)
+      dst = np.concatenate(dsts)
+      if not directed:
+        src, dst = (np.concatenate([src, dst]),
+                    np.concatenate([dst, src]))
+      w = np.concatenate(weights) if weights else None
+      if not directed and w is not None:
+        w = np.concatenate([w, w])
+      n = num_nodes or int(max(src.max(), dst.max())) + 1
+      self.init_graph(edge_index=np.stack([src, dst]), edge_weights=w,
+                      num_nodes=n, graph_mode=graph_mode)
+    if ids_l:
+      ids = np.concatenate(ids_l)
+      feats = np.concatenate(feats_l)
+      # table must cover every graph node, not just ids seen by the reader
+      n_rows = max(int(ids.max()) + 1,
+                   num_nodes or 0,
+                   self.graph.num_nodes if self.graph is not None else 0)
+      dense = np.zeros((n_rows, feats.shape[1]), feats.dtype)
+      dense[ids] = feats
+      self.init_node_features(dense)
+      if labels_l:
+        labels = np.concatenate(labels_l)
+        dense_y = np.zeros(n_rows, labels.dtype)
+        dense_y[ids] = labels
+        self.init_node_labels(dense_y)
+    return self
+
+
+def csv_edge_reader(path: str, chunk_size: int = 1_000_000,
+                    src_col: int = 0, dst_col: int = 1,
+                    weight_col: Optional[int] = None,
+                    delimiter: str = ','):
+  """Chunked CSV edge reader (the common_io stand-in)."""
+  import itertools
+  with open(path) as f:
+    while True:
+      rows = list(itertools.islice(f, chunk_size))
+      if not rows:
+        return
+      parts = [r.rstrip('\n').split(delimiter) for r in rows if r.strip()]
+      src = np.array([int(p[src_col]) for p in parts], np.int64)
+      dst = np.array([int(p[dst_col]) for p in parts], np.int64)
+      if weight_col is not None:
+        w = np.array([float(p[weight_col]) for p in parts], np.float32)
+        yield src, dst, w
+      else:
+        yield src, dst

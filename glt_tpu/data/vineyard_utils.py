@@ -1,0 +1,47 @@
+"""Vineyard (GraphScope) connector — optional, gated.
+
+Reference: graphlearn_torch/python/data/vineyard_utils.py + v6d/ (reads
+graph fragments from a vineyard store as CSR + feature tensors; built
+only WITH_VINEYARD, setup.py:35-36). A vineyard client is not part of
+this environment; the functions keep the reference API surface and raise
+with instructions if the client is missing so downstream code can gate
+on availability, matching the reference's optional-extension pattern.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+def _require_vineyard():
+  try:
+    import vineyard  # noqa: F401
+    return vineyard
+  except ImportError as e:
+    raise ImportError(
+        'vineyard support requires the vineyard client (pip install '
+        'vineyard) and a running vineyard/GraphScope instance; this '
+        'optional connector is disabled in the current environment'
+    ) from e
+
+
+def vineyard_to_csr(sock: str, object_id, edge_label: int,
+                    edge_dir: str = 'out'):
+  """Reference data/vineyard_utils.py:30-41: fragment -> (indptr,
+  indices, edge_ids)."""
+  _require_vineyard()
+  raise NotImplementedError(
+      'vineyard fragment decoding is pending a live vineyard service')
+
+
+def load_vertex_feature_from_vineyard(sock: str, object_id,
+                                      feature_labels, vertex_label: int):
+  _require_vineyard()
+  raise NotImplementedError(
+      'vineyard feature loading is pending a live vineyard service')
+
+
+def load_edge_feature_from_vineyard(sock: str, object_id,
+                                    feature_labels, edge_label: int):
+  _require_vineyard()
+  raise NotImplementedError(
+      'vineyard feature loading is pending a live vineyard service')

@@ -42,3 +42,11 @@ __all__ += [
     'async_request_server', 'init_client', 'request_server',
     'shutdown_client',
 ]
+from .dist_hetero import DistHeteroGraph, DistHeteroNeighborSampler, \
+    DistHeteroTrainStep
+
+__all__ += ['DistHeteroGraph', 'DistHeteroNeighborSampler',
+            'DistHeteroTrainStep']
+from .dist_random_partitioner import DistRandomPartitioner
+
+__all__ += ['DistRandomPartitioner']

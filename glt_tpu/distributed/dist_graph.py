@@ -119,6 +119,12 @@ class DistGraph:
     (the reference test pattern of running all ranks in one host)."""
     from ..partition import load_partition, load_meta
     meta = load_meta(root_dir)
+    need = 'by_src' if edge_dir == 'out' else 'by_dst'
+    got = meta.get('edge_assign', 'by_src')
+    if got != need:
+      raise ValueError(
+          f'partition was edge-assigned {got!r} but edge_dir='
+          f'{edge_dir!r} sampling requires {need!r}')
     parts, node_pb = [], None
     for p in range(meta['num_parts']):
       _, g, _, _, npb, _ = load_partition(root_dir, p)

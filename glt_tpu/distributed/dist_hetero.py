@@ -1,0 +1,583 @@
+"""Hetero distributed stores + sampler — IGBH-class workloads.
+
+Reference: the hetero paths of dist_neighbor_sampler.py (per-etype
+concurrent rpc tasks, :315-347) and dist_dataset/dist_graph hetero
+handling; the deployment target is examples/igbh/dist_train_rgnn.py
+(billion-edge hetero training). TPU design: one DistGraph-style sharded
+store per edge type (all on the same mesh), per-node-type dense inducer
+tables, and a shard_map hop loop that issues the collective one-hop of
+every edge type then merges each destination type once — the same
+structure as the single-device hetero engine with the one-hop swapped
+for the all_to_all version.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.unique import dense_assign, dense_init, dense_make_tables, \
+    dense_reset
+from ..parallel.collectives import all_to_all, bucket_by_owner, unbucket
+from ..sampler.base import HeteroSamplerOutput
+from ..typing import EdgeType, NodeType, reverse_edge_type
+from ..utils import as_numpy
+from ..utils.rng import RandomSeedManager
+from .dist_graph import DistGraph
+from .dist_neighbor_sampler import make_dist_one_hop
+
+
+class DistHeteroGraph:
+  """Dict of per-edge-type sharded stores over one mesh.
+
+  Built from per-partition hetero GraphPartitionData dicts + per-ntype
+  partition books.
+  """
+
+  def __init__(self, mesh: Mesh, node_counts: Dict[NodeType, int],
+               parts_per_etype: Dict[EdgeType, Sequence],
+               node_pbs: Dict[NodeType, object], edge_dir: str = 'out',
+               axis: str = 'data'):
+    self.mesh = mesh
+    self.axis = axis
+    self.edge_dir = edge_dir
+    self.node_counts = dict(node_counts)
+    self.graphs: Dict[EdgeType, DistGraph] = {}
+    for etype, parts in parts_per_etype.items():
+      src_t, _, dst_t = etype
+      row_t = src_t if edge_dir == 'out' else dst_t
+      col_t = dst_t if edge_dir == 'out' else src_t
+      # the per-etype store routes by the *row* type's partition book and
+      # emits col-type global ids
+      store = DistGraph.__new__(DistGraph)
+      self._build_etype_store(store, mesh, parts, node_pbs[row_t],
+                              node_counts[row_t], node_counts[col_t],
+                              axis)
+      self.graphs[etype] = store
+    self.num_partitions = mesh.shape[axis]
+
+  @staticmethod
+  def _build_etype_store(store, mesh, parts, node_pb, num_rows_global,
+                         num_cols_global, axis):
+    """Like DistGraph.__init__ but with independent row/col id spaces."""
+    from ..data import Topology
+    from .dist_graph import _pb_dense
+    n_parts = len(parts)
+    indptrs, indices_l, eids_l, locals_l = [], [], [], []
+    max_rows, max_edges = 1, 1
+    built = []
+    for g in parts:
+      src, dst = as_numpy(g.edge_index)
+      row, col = src, dst  # caller passes pre-oriented (row, col)
+      owned = np.unique(row)
+      local_of = np.full(num_rows_global, -1, np.int32)
+      local_of[owned] = np.arange(owned.shape[0], dtype=np.int32)
+      topo = Topology(edge_index=np.stack([local_of[row], col]),
+                      edge_ids=as_numpy(g.eids), layout='CSR',
+                      num_rows=owned.shape[0],
+                      num_cols=num_cols_global)
+      built.append((topo, local_of))
+      max_rows = max(max_rows, owned.shape[0])
+      max_edges = max(max_edges, topo.num_edges)
+    for topo, local_of in built:
+      ip = topo.indptr.astype(np.int32)
+      ip = np.concatenate(
+          [ip, np.full(max_rows + 1 - ip.shape[0], ip[-1], np.int32)])
+      indptrs.append(ip)
+      indices_l.append(np.concatenate(
+          [topo.indices,
+           np.zeros(max_edges - topo.num_edges, topo.indices.dtype)]))
+      eids_l.append(np.concatenate(
+          [topo.edge_ids.astype(np.int64),
+           np.full(max_edges - topo.num_edges, -1, np.int64)]))
+      locals_l.append(local_of)
+    shard = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+    store.mesh = mesh
+    store.axis = axis
+    store.num_nodes = num_rows_global
+    store.edge_dir = 'out'
+    store.indptr = jax.device_put(np.stack(indptrs), shard)
+    store.indices = jax.device_put(np.stack(indices_l), shard)
+    store.edge_ids = jax.device_put(np.stack(eids_l), shard)
+    store.local_row = jax.device_put(np.stack(locals_l), shard)
+    store.node_pb = jax.device_put(_pb_dense(node_pb, num_rows_global),
+                                   repl)
+    store.num_partitions = n_parts
+    store.max_rows = max_rows
+    store.max_edges = max_edges
+
+  @classmethod
+  def from_dataset_partitions(cls, mesh: Mesh, root_dir: str,
+                              edge_dir: str = 'out', axis: str = 'data'):
+    from ..partition import load_meta, load_partition
+    meta = load_meta(root_dir)
+    assert meta['data_cls'] == 'hetero'
+    # routing uses the expand-from node's PB: edges must have been
+    # assigned by that same endpoint or cross-partition neighbors would
+    # silently vanish (ok = local_row >= 0 masks them)
+    need = 'by_src' if edge_dir == 'out' else 'by_dst'
+    got = meta.get('edge_assign', 'by_src')
+    if got != need:
+      raise ValueError(
+          f'partition was edge-assigned {got!r} but edge_dir='
+          f'{edge_dir!r} sampling requires {need!r}; re-partition with '
+          f'edge_assign_strategy={need!r}')
+    etypes = [tuple(e) for e in meta['edge_types']]
+    parts_per_etype = {e: [] for e in etypes}
+    node_pbs = None
+    for p in range(meta['num_parts']):
+      _, graphs, _, _, npb, _ = load_partition(root_dir, p)
+      node_pbs = npb
+      for e in etypes:
+        g = graphs[e]
+        src, dst = g.edge_index
+        if edge_dir == 'out':
+          oriented = np.stack([src, dst])
+        else:
+          oriented = np.stack([dst, src])
+        from ..typing import GraphPartitionData
+        parts_per_etype[e].append(
+            GraphPartitionData(edge_index=oriented, eids=g.eids,
+                               weights=g.weights))
+    node_counts = {nt: pb.table.shape[0] for nt, pb in node_pbs.items()}
+    return cls(mesh, node_counts, parts_per_etype, node_pbs,
+               edge_dir=edge_dir, axis=axis)
+
+
+class DistHeteroNeighborSampler:
+  """SPMD hetero sampling: per-device seed batches of one seed type."""
+
+  def __init__(self, graph: DistHeteroGraph, num_neighbors,
+               with_edge: bool = False, seed: Optional[int] = None):
+    self.g = graph
+    self.mesh = graph.mesh
+    self.axis = graph.axis
+    self.with_edge = with_edge
+    self.edge_types = list(graph.graphs.keys())
+    if isinstance(num_neighbors, dict):
+      self.num_neighbors = {k: list(v) for k, v in num_neighbors.items()}
+    else:
+      self.num_neighbors = {k: list(num_neighbors)
+                            for k in self.edge_types}
+    hops = {len(v) for v in self.num_neighbors.values()}
+    assert len(hops) == 1
+    self.num_hops = hops.pop()
+    self._base_key = jax.random.key(
+        seed if seed is not None
+        else RandomSeedManager.getInstance().getSeed())
+    self._step = 0
+    self._fn_cache = {}
+    n_dev = self.mesh.shape[self.axis]
+    shard = NamedSharding(self.mesh, P(self.axis))
+    self.tables = {}
+    for t, n in graph.node_counts.items():
+      table, scratch = dense_make_tables(n)
+      self.tables[t] = (
+          jax.device_put(jnp.broadcast_to(table, (n_dev,) + table.shape),
+                         shard),
+          jax.device_put(
+              jnp.broadcast_to(scratch, (n_dev,) + scratch.shape),
+              shard))
+
+  def _next_key(self):
+    self._step += 1
+    return jax.random.fold_in(self._base_key, self._step)
+
+  def _trav(self):
+    out = {}
+    for etype in self.edge_types:
+      src_t, _, dst_t = etype
+      row_t = src_t if self.g.edge_dir == 'out' else dst_t
+      col_t = dst_t if self.g.edge_dir == 'out' else src_t
+      out[etype] = (row_t, col_t)
+    return out
+
+  def _caps(self, batch_size: int, seed_type: NodeType):
+    trav = self._trav()
+    types = list(self.g.node_counts)
+    caps = [{t: (batch_size if t == seed_type else 0) for t in types}]
+    for h in range(self.num_hops):
+      nxt = {t: 0 for t in types}
+      for etype, (row_t, col_t) in trav.items():
+        nxt[col_t] += caps[h][row_t] * self.num_neighbors[etype][h]
+      caps.append(nxt)
+    budgets = {t: max(1, sum(c[t] for c in caps)) for t in types}
+    return caps, budgets
+
+  def _make_device_core(self, batch_size: int, seed_type: NodeType):
+    """Returns device_core(shards, seeds, n_valid_scalar, key, flat_tables)
+    -> (result dict, out_tables) with NO leading shard dims — reusable by
+    the train step."""
+    g = self.g
+    trav = self._trav()
+    caps, budgets = self._caps(batch_size, seed_type)
+    axis = self.axis
+    n_parts = g.num_partitions
+    types = list(g.node_counts)
+    # an edge type participates only if its expand-from type ever has a
+    # frontier; inactive types produce no edges and must be excluded from
+    # outputs (and from shard_map out_specs)
+    etypes = [e for e in self.edge_types
+              if any(caps[h][trav[e][0]] * self.num_neighbors[e][h] > 0
+                     for h in range(self.num_hops))]
+
+    def device_core(shards, seeds, n_valid, key, tables):
+      one_hops = {}
+      for e in etypes:
+        sh = shards[e]
+        one_hops[e] = make_dist_one_hop(
+            dict(indptr=sh['indptr'], indices=sh['indices'],
+                 edge_ids=sh['edge_ids'],
+                 local_row=sh['local_row'],
+                 node_pb=sh['node_pb']),
+            g.graphs[e].num_nodes, n_parts, g.graphs[e].max_rows, axis)
+
+      states = {t: dense_init(tables[t][0], tables[t][1],
+                              budgets[t]) for t in types}
+      seed_mask = jnp.arange(batch_size) < n_valid
+      states[seed_type], seed_labels = dense_assign(
+          states[seed_type], seeds, seed_mask)
+      frontier = {}
+      for t in types:
+        c0 = max(1, caps[0][t])
+        labels = jnp.arange(c0, dtype=jnp.int32)
+        frontier[t] = (jax.lax.slice(states[t].nodes, (0,), (c0,)),
+                       labels, labels < states[t].count)
+
+      rows_d, cols_d, mask_d, eid_d = {}, {}, {}, {}
+      hop_nodes = {t: [states[t].count] for t in types}
+      hop_edges = {}
+      for h in range(self.num_hops):
+        per_type_nbrs = {t: [] for t in types}
+        per_meta = []
+        for e, (row_t, col_t) in trav.items():
+          k = self.num_neighbors[e][h]
+          if caps[h][row_t] == 0 or k == 0:
+            continue
+          f_ids, f_labels, f_mask = frontier[row_t]
+          key, sub = jax.random.split(key)
+          out = one_hops[e](f_ids, k, sub, f_mask)
+          per_type_nbrs[col_t].append(
+              (out.nbrs.reshape(-1), out.mask.reshape(-1)))
+          per_meta.append((e, col_t, jnp.repeat(f_labels, k),
+                           out.mask.reshape(-1),
+                           out.eids.reshape(-1) if self.with_edge
+                           else None,
+                           caps[h][row_t] * k))
+        prev = {t: states[t].count for t in types}
+        labels_by_type = {}
+        for t, chunks in per_type_nbrs.items():
+          if not chunks:
+            continue
+          ids = jnp.concatenate([c[0] for c in chunks])
+          ok = jnp.concatenate([c[1] for c in chunks])
+          states[t], labels = dense_assign(states[t], ids, ok)
+          labels_by_type[t] = labels
+        cursor = {t: 0 for t in types}
+        for e, col_t, rows_parent, mask, eids, width in per_meta:
+          s = cursor[col_t]
+          cursor[col_t] += width
+          lab = jax.lax.slice(labels_by_type[col_t], (s,), (s + width,))
+          rows_d.setdefault(e, []).append(rows_parent)
+          cols_d.setdefault(e, []).append(lab)
+          mask_d.setdefault(e, []).append(mask)
+          if self.with_edge:
+            eid_d.setdefault(e, []).append(eids)
+          hop_edges.setdefault(e, []).append(mask.sum().astype(jnp.int32))
+        for t in types:
+          cap_next = max(1, caps[h + 1][t])
+          labels = prev[t] + jnp.arange(cap_next, dtype=jnp.int32)
+          frontier[t] = (
+              jnp.take(states[t].nodes,
+                       jnp.minimum(labels, budgets[t])),
+              labels, labels < states[t].count)
+          hop_nodes[t].append(states[t].count - prev[t])
+
+      out_tables = {}
+      for t in types:
+        out_tables[t] = dense_reset(states[t])
+      result = dict(
+          node={t: jax.lax.slice(states[t].nodes, (0,),
+                                 (budgets[t],)) for t in types},
+          node_count={t: states[t].count for t in types},
+          row={e: jnp.concatenate(v) for e, v in rows_d.items()},
+          col={e: jnp.concatenate(v) for e, v in cols_d.items()},
+          edge_mask={e: jnp.concatenate(v)
+                     for e, v in mask_d.items()},
+          batch=jax.lax.slice(states[seed_type].nodes, (0,),
+                              (batch_size,)),
+          seed_labels=seed_labels,
+          num_sampled_nodes={t: jnp.stack(v)
+                             for t, v in hop_nodes.items()},
+          num_sampled_edges={e: jnp.stack(v)
+                             for e, v in hop_edges.items()},
+      )
+      if self.with_edge:
+        result['edge'] = {e: jnp.concatenate(v)
+                          for e, v in eid_d.items()}
+      return result, out_tables
+
+    return device_core, caps, budgets, etypes
+
+  def _build(self, batch_size: int, seed_type: NodeType):
+    g = self.g
+    types = list(g.node_counts)
+    device_core, caps, budgets, etypes = self._make_device_core(
+        batch_size, seed_type)
+
+    def device_fn(shards, seeds, n_valid, key, tables):
+      shards_in = {e: dict(indptr=sh['indptr'][0],
+                           indices=sh['indices'][0],
+                           edge_ids=sh['edge_ids'][0],
+                           local_row=sh['local_row'][0],
+                           node_pb=sh['node_pb'])
+                   for e, sh in shards.items()}
+      key = jax.random.fold_in(key[0], jax.lax.axis_index(self.axis))
+      flat_tables = {t: (tables[t][0][0], tables[t][1][0])
+                     for t in tables}
+      result, out_tables = device_core(shards_in, seeds, n_valid[0], key,
+                                       flat_tables)
+      result = jax.tree_util.tree_map(lambda a: a[None], result)
+      out_tables = {t: (tb[None], sc[None])
+                    for t, (tb, sc) in out_tables.items()}
+      return result, out_tables
+
+    sp = P(self.axis)
+    shard_specs = {e: dict(indptr=sp, indices=sp, edge_ids=sp,
+                           local_row=sp, node_pb=P())
+                   for e in etypes}
+    out_elem = {
+        'node': {t: sp for t in types},
+        'node_count': {t: sp for t in types},
+        'row': {e: sp for e in etypes}, 'col': {e: sp for e in etypes},
+        'edge_mask': {e: sp for e in etypes},
+        'batch': sp, 'seed_labels': sp,
+        'num_sampled_nodes': {t: sp for t in types},
+        'num_sampled_edges': {e: sp for e in etypes},
+    }
+    if self.with_edge:
+      out_elem['edge'] = {e: sp for e in etypes}
+    table_specs = {t: (sp, sp) for t in types}
+
+    fn = jax.shard_map(
+        device_fn, mesh=self.mesh,
+        in_specs=(shard_specs, sp, sp, sp, table_specs),
+        out_specs=(out_elem, table_specs), check_vma=False)
+
+    @functools.partial(jax.jit, donate_argnums=(3,))
+    def step(seeds, n_valid, keys, tables):
+      shards = {e: dict(indptr=g.graphs[e].indptr,
+                        indices=g.graphs[e].indices,
+                        edge_ids=g.graphs[e].edge_ids,
+                        local_row=g.graphs[e].local_row,
+                        node_pb=g.graphs[e].node_pb) for e in etypes}
+      return fn(shards, seeds, n_valid, keys, tables)
+
+    return step
+
+  def sample_from_nodes(self, seed_type: NodeType,
+                        seeds_per_device, n_valid_per_device=None,
+                        key=None) -> dict:
+    seeds = as_numpy(seeds_per_device)
+    n_dev = self.mesh.shape[self.axis]
+    if seeds.ndim == 2:
+      seeds = seeds.reshape(-1)
+    batch_size = seeds.shape[0] // n_dev
+    if n_valid_per_device is None:
+      n_valid_per_device = np.full(n_dev, batch_size, np.int32)
+    cache_key = (batch_size, seed_type)
+    if cache_key not in self._fn_cache:
+      self._fn_cache[cache_key] = self._build(batch_size, seed_type)
+    if key is None:
+      key = self._next_key()
+    shard = NamedSharding(self.mesh, P(self.axis))
+    out, self.tables = self._fn_cache[cache_key](
+        jax.device_put(jnp.asarray(seeds, jnp.int32), shard),
+        jax.device_put(jnp.asarray(n_valid_per_device, jnp.int32), shard),
+        jax.random.split(key, n_dev), self.tables)
+
+    def final_key(e):
+      return reverse_edge_type(e) if self.g.edge_dir == 'out' else e
+
+    # message-passing orientation + key reversal, as the single-device
+    # hetero engine emits
+    out['row'], out['col'] = (
+        {final_key(e): v for e, v in out['col'].items()},
+        {final_key(e): v for e, v in out['row'].items()})
+    out['edge_mask'] = {final_key(e): v
+                        for e, v in out['edge_mask'].items()}
+    out['num_sampled_edges'] = {
+        final_key(e): v for e, v in out['num_sampled_edges'].items()}
+    if self.with_edge:
+      out['edge'] = {final_key(e): v for e, v in out['edge'].items()}
+    out['input_type'] = seed_type
+    return out
+
+
+class DistHeteroTrainStep:
+  """One-program hetero distributed training (the IGBH deployment shape,
+  examples/igbh/dist_train_rgnn.py): hetero collective sampling +
+  per-type feature all_to_all + RGNN forward/backward + gradient pmean,
+  all inside a single shard_map step.
+  """
+
+  def __init__(self, graph: DistHeteroGraph,
+               features: Dict[NodeType, object],   # DistFeature per type
+               model, tx, labels: Dict[NodeType, np.ndarray],
+               num_neighbors, batch_size_per_device: int,
+               seed_type: NodeType, seed: Optional[int] = None):
+    import optax
+    self.g = graph
+    self.features = features
+    self.model = model
+    self.tx = tx
+    self.seed_type = seed_type
+    self.bs = int(batch_size_per_device)
+    self.mesh = graph.mesh
+    self.axis = graph.axis
+    self.sampler = DistHeteroNeighborSampler(graph, num_neighbors,
+                                             seed=seed)
+    self.labels = {t: jax.device_put(as_numpy(v),
+                                     NamedSharding(self.mesh, P()))
+                   for t, v in labels.items()}
+    self._optax = optax
+    self._step_fn = self._build()
+
+  def _final_key(self, e):
+    return reverse_edge_type(e) if self.g.edge_dir == 'out' else e
+
+  def dummy_batch(self):
+    from ..loader.transform import HeteroBatch
+    _, caps, budgets, active = self.sampler._make_device_core(
+        self.bs, self.seed_type)
+    trav = {e: tc for e, tc in self.sampler._trav().items()
+            if e in active}
+    x_dict = {t: jnp.zeros((budgets[t], self.features[t].feature_dim))
+              for t in self.features}
+    row_d, col_d, mask_d = {}, {}, {}
+    for e, (row_t, col_t) in trav.items():
+      ecap = sum(max(caps[h][row_t], 0) * self.sampler.num_neighbors[e][h]
+                 for h in range(self.sampler.num_hops))
+      ecap = max(ecap, 1)
+      k = self._final_key(e)
+      row_d[k] = jnp.zeros((ecap,), jnp.int32)
+      col_d[k] = jnp.zeros((ecap,), jnp.int32)
+      mask_d[k] = jnp.zeros((ecap,), bool)
+    return HeteroBatch(
+        x_dict=x_dict, row_dict=row_d, col_dict=col_d,
+        edge_mask_dict=mask_d,
+        node_dict={t: jnp.zeros((budgets[t],), jnp.int32)
+                   for t in self.features},
+        node_count_dict={t: jnp.zeros((), jnp.int32)
+                         for t in self.features},
+        y_dict={self.seed_type: jnp.zeros((self.bs,), jnp.int32)},
+        input_type=self.seed_type, batch_size=self.bs)
+
+  def init_params(self, key):
+    params = self.model.init(key, self.dummy_batch())
+    return jax.device_put(params, NamedSharding(self.mesh, P()))
+
+  def _build(self):
+    from ..loader.transform import HeteroBatch
+    optax = self._optax
+    g, model, tx, axis, bs = (self.g, self.model, self.tx, self.axis,
+                              self.bs)
+    seed_type = self.seed_type
+    device_core, caps, budgets, etypes = self.sampler._make_device_core(
+        bs, seed_type)
+    types = list(g.node_counts)
+    feats = self.features
+
+    def device_step(params, opt_state, shards, feat_shards, labels,
+                    seeds, n_valid, key, tables):
+      shards_in = {e: dict(indptr=sh['indptr'][0],
+                           indices=sh['indices'][0],
+                           edge_ids=sh['edge_ids'][0],
+                           local_row=sh['local_row'][0],
+                           node_pb=sh['node_pb'])
+                   for e, sh in shards.items()}
+      my_key = jax.random.fold_in(key[0], jax.lax.axis_index(axis))
+      flat_tables = {t: (tables[t][0][0], tables[t][1][0])
+                     for t in tables}
+      out, out_tables = device_core(shards_in, seeds, n_valid[0], my_key,
+                                    flat_tables)
+      x_dict = {}
+      for t in types:
+        fs = feat_shards[t]
+        valid = (jnp.arange(out['node'][t].shape[0])
+                 < out['node_count'][t])
+        x_dict[t] = feats[t].lookup_local(
+            fs['array'][0], fs['id2index'][0], fs['feat_pb'][0],
+            jnp.maximum(out['node'][t], 0), valid, axis_name=axis)
+      y = jnp.take(labels[seed_type],
+                   jnp.maximum(out['batch'], 0)[:bs])
+      fk = self._final_key
+      batch = HeteroBatch(
+          x_dict=x_dict,
+          row_dict={fk(e): out['col'][e] for e in etypes},
+          col_dict={fk(e): out['row'][e] for e in etypes},
+          edge_mask_dict={fk(e): out['edge_mask'][e] for e in etypes},
+          node_dict=out['node'], node_count_dict=out['node_count'],
+          y_dict={seed_type: y}, input_type=seed_type, batch_size=bs)
+
+      def loss_fn(p):
+        logits = model.apply(p, batch)
+        mask = jnp.arange(bs) < n_valid[0]
+        l = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        return jnp.where(mask, l, 0).sum() / jnp.maximum(mask.sum(), 1)
+
+      loss, grads = jax.value_and_grad(loss_fn)(params)
+      grads = jax.lax.pmean(grads, axis)
+      loss = jax.lax.pmean(loss, axis)
+      updates, opt_state = tx.update(grads, opt_state, params)
+      params = optax.apply_updates(params, updates)
+      out_tables = {t: (tb[None], sc[None])
+                    for t, (tb, sc) in out_tables.items()}
+      return params, opt_state, out_tables, loss[None]
+
+    sp = P(self.axis)
+    shard_specs = {e: dict(indptr=sp, indices=sp, edge_ids=sp,
+                           local_row=sp, node_pb=P()) for e in etypes}
+    feat_specs = {t: dict(array=sp, id2index=sp, feat_pb=sp)
+                  for t in types}
+    table_specs = {t: (sp, sp) for t in types}
+    label_specs = {t: P() for t in self.labels}
+
+    fn = jax.shard_map(
+        device_step, mesh=self.mesh,
+        in_specs=(P(), P(), shard_specs, feat_specs, label_specs, sp, sp,
+                  sp, table_specs),
+        out_specs=(P(), P(), table_specs, sp), check_vma=False)
+
+    import functools
+    @functools.partial(jax.jit, donate_argnums=(2,))
+    def step(params, opt_state, tables, seeds, n_valid, keys):
+      shards = {e: dict(indptr=g.graphs[e].indptr,
+                        indices=g.graphs[e].indices,
+                        edge_ids=g.graphs[e].edge_ids,
+                        local_row=g.graphs[e].local_row,
+                        node_pb=g.graphs[e].node_pb) for e in etypes}
+      feat_shards = {t: dict(array=feats[t].array,
+                             id2index=feats[t].id2index,
+                             feat_pb=feats[t].feat_pb) for t in types}
+      return fn(params, opt_state, shards, feat_shards, self.labels,
+                seeds, n_valid, keys, tables)
+
+    return step
+
+  def __call__(self, params, opt_state, seeds, n_valid_per_device, key):
+    n_dev = self.mesh.shape[self.axis]
+    shard = NamedSharding(self.mesh, P(self.axis))
+    seeds = jax.device_put(
+        jnp.asarray(np.asarray(seeds).reshape(-1), jnp.int32), shard)
+    nv = jax.device_put(jnp.asarray(n_valid_per_device, jnp.int32),
+                        shard)
+    keys = jax.random.split(key, n_dev)
+    params, opt_state, self.sampler.tables, loss = self._step_fn(
+        params, opt_state, self.sampler.tables, seeds, nv, keys)
+    return params, opt_state, loss

@@ -1,0 +1,192 @@
+"""Online parallel partitioning — DistRandomPartitioner.
+
+Reference: graphlearn_torch/python/distributed/dist_random_partitioner.py
+(539): each rank partitions its *slice* of nodes/edges/features (mod-hash
+over its id range), RPC-pushes per-partition payloads to their owners
+(DistPartitionManager.process, :88-127), and each owner saves its own
+partition locally (rank == partition index). Used when the graph is too
+big for one partitioner.
+
+Here the push fabric is the framework's socket RPC: every rank runs an
+RpcServer exposing 'push_*' callees; chunked sends (``_partition_by_chunk``
+equivalent) with a barrier per phase via the built-in barrier callee.
+The same object also works world_size=1 (pure local) for testing.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..channel import pack_message, unpack_message
+from ..utils import as_numpy
+from .rpc import RpcClient, RpcServer
+
+CHUNK = 2 * 1024 * 1024
+
+
+class _PartitionBuffer:
+  """Accumulates pushed rows for the partition this rank owns
+  (the DistPartitionManager analogue)."""
+
+  def __init__(self):
+    self.lock = threading.Lock()
+    self.edge_chunks: List[np.ndarray] = []     # [3, m] rows/cols/eids
+    self.node_feat_chunks: List[np.ndarray] = []
+    self.node_id_chunks: List[np.ndarray] = []
+
+  def push_edges(self, payload: bytes) -> bool:
+    msg = unpack_message(payload)
+    with self.lock:
+      self.edge_chunks.append(
+          np.stack([msg['rows'], msg['cols'], msg['eids']]))
+    return True
+
+  def push_node_feat(self, payload: bytes) -> bool:
+    msg = unpack_message(payload)
+    with self.lock:
+      self.node_id_chunks.append(msg['ids'])
+      self.node_feat_chunks.append(msg['feats'])
+    return True
+
+
+class DistRandomPartitioner:
+  """Args:
+    output_dir: shared filesystem root (every rank writes part{rank}).
+    rank / world_size: this rank's identity; rank == partition index.
+    num_nodes: global node count.
+    edge_slice: this rank's [2, E_r] COO slice + eid_slice global edge
+      ids ([E_r]); edges are re-owned by src node (by_src).
+    node_ids / node_feat: this rank's feature slice (global ids + rows).
+    master_addr / master_port: rpc rendezvous (port + rank per server).
+  """
+
+  def __init__(self, output_dir: str, rank: int, world_size: int,
+               num_nodes: int, edge_slice, eid_slice,
+               node_ids=None, node_feat=None,
+               master_addr: str = '127.0.0.1', master_port: int = 30500,
+               chunk_size: int = CHUNK, seed: int = 0):
+    self.output_dir = output_dir
+    self.rank = int(rank)
+    self.world = int(world_size)
+    self.num_nodes = int(num_nodes)
+    self.edge_slice = as_numpy(edge_slice)
+    self.eid_slice = as_numpy(eid_slice)
+    self.node_ids = as_numpy(node_ids)
+    self.node_feat = as_numpy(node_feat)
+    self.chunk_size = int(chunk_size)
+    self.seed = seed
+    self.buffer = _PartitionBuffer()
+    self.server = RpcServer(master_addr, master_port + rank)
+    self.server.register('push_edges', self.buffer.push_edges)
+    self.server.register('push_node_feat', self.buffer.push_node_feat)
+    self.addr = master_addr
+    self.base_port = master_port
+    self._clients: Dict[int, RpcClient] = {}
+
+  def _client(self, peer: int) -> RpcClient:
+    if peer not in self._clients:
+      self._clients[peer] = RpcClient(self.addr, self.base_port + peer)
+    return self._clients[peer]
+
+  def _owner_of(self, ids: np.ndarray) -> np.ndarray:
+    """Deterministic mod-hash ownership over the whole id space
+    (reference _partition_node, :294-330)."""
+    rng_mix = (ids.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+               + np.uint64(self.seed))
+    return ((rng_mix >> np.uint64(32)) % np.uint64(self.world)) \
+        .astype(np.int32)
+
+  def _push(self, peer: int, method: str, payload: dict) -> None:
+    if peer == self.rank:
+      getattr(self.buffer, method)(pack_message(payload))
+    else:
+      self._client(peer).request(method, pack_message(payload))
+
+  def _barrier(self, key: str) -> None:
+    self._client(0).request('_barrier', key, self.world)
+
+  def partition(self) -> np.ndarray:
+    """Runs all phases; returns the full node partition table."""
+    node_pb = self._owner_of(np.arange(self.num_nodes, dtype=np.int64))
+
+    # phase 1: edges by src owner, chunked
+    rows, cols = self.edge_slice
+    for lo in range(0, rows.shape[0], self.chunk_size):
+      hi = min(lo + self.chunk_size, rows.shape[0])
+      owner = node_pb[rows[lo:hi]]
+      for p in range(self.world):
+        sel = np.nonzero(owner == p)[0] + lo
+        if sel.size:
+          self._push(p, 'push_edges',
+                     {'rows': rows[sel], 'cols': cols[sel],
+                      'eids': self.eid_slice[sel]})
+    self._barrier('edges_done')
+
+    # phase 2: node features by owner. EVERY rank joins the phase barrier
+    # (a rank may legitimately hold no feature slice).
+    if self.node_ids is not None:
+      for lo in range(0, self.node_ids.shape[0], self.chunk_size):
+        hi = min(lo + self.chunk_size, self.node_ids.shape[0])
+        ids = self.node_ids[lo:hi]
+        owner = node_pb[ids]
+        for p in range(self.world):
+          sel = np.nonzero(owner == p)[0]
+          if sel.size:
+            self._push(p, 'push_node_feat',
+                       {'ids': ids[sel],
+                        'feats': self.node_feat[lo:hi][sel]})
+    self._barrier('feats_done')
+
+    # phase 3: each rank saves its own partition (rank == partition)
+    self._save(node_pb)
+    self._barrier('save_done')
+    if self.rank == 0:
+      self._save_meta(node_pb)
+    self._barrier('meta_done')
+    return node_pb
+
+  def _save(self, node_pb: np.ndarray) -> None:
+    pdir = os.path.join(self.output_dir, f'part{self.rank}')
+    os.makedirs(os.path.join(pdir, 'graph'), exist_ok=True)
+    if self.buffer.edge_chunks:
+      all_e = np.concatenate(self.buffer.edge_chunks, axis=1)
+    else:
+      all_e = np.zeros((3, 0), np.int64)
+    np.savez(os.path.join(pdir, 'graph', 'data.npz'),
+             rows=all_e[0], cols=all_e[1], eids=all_e[2])
+    if self.buffer.node_feat_chunks:
+      ids = np.concatenate(self.buffer.node_id_chunks)
+      feats = np.concatenate(self.buffer.node_feat_chunks)
+      order = np.argsort(ids)
+      os.makedirs(os.path.join(pdir, 'node_feat'), exist_ok=True)
+      np.savez(os.path.join(pdir, 'node_feat', 'data.npz'),
+               ids=ids[order], feats=feats[order])
+
+  def _save_meta(self, node_pb: np.ndarray) -> None:
+    import json
+    np.save(os.path.join(self.output_dir, 'node_pb.npy'),
+            node_pb.astype(np.int32))
+    # assemble the global edge PB from every rank's saved partition (all
+    # parts are on the shared filesystem after the 'save_done' barrier) —
+    # load_partition requires it
+    chunks = []
+    for r in range(self.world):
+      z = np.load(os.path.join(self.output_dir, f'part{r}', 'graph',
+                               'data.npz'))
+      chunks.append((z['eids'], r))
+    total = sum(c[0].shape[0] for c in chunks)
+    edge_pb = np.zeros(total, np.int32)
+    for eids, r in chunks:
+      edge_pb[eids] = r
+    np.save(os.path.join(self.output_dir, 'edge_pb.npy'), edge_pb)
+    with open(os.path.join(self.output_dir, 'META.json'), 'w') as f:
+      json.dump({'num_parts': self.world, 'data_cls': 'homo',
+                 'edge_dir': 'out', 'edge_assign': 'by_src'}, f)
+
+  def shutdown(self) -> None:
+    for c in self._clients.values():
+      c.close()
+    self.server.stop()

@@ -128,16 +128,29 @@ class RpcClient:
 
   _pool = ThreadPoolExecutor(max_workers=16)
 
-  def __init__(self, host: str, port: int, timeout: float = 180.0):
+  def __init__(self, host: str, port: int, timeout: float = 180.0,
+               connect_retries: int = 60, retry_interval: float = 0.5):
     self._addr = (host, port)
     self._timeout = timeout
     self._lock = threading.Lock()
     self._sock = None
-    self._connect()
+    self._connect(connect_retries, retry_interval)
 
-  def _connect(self) -> None:
-    self._sock = socket.create_connection(self._addr,
-                                          timeout=self._timeout)
+  def _connect(self, retries: int = 1, interval: float = 0.5) -> None:
+    # peers race at startup (the reference retries rendezvous the same
+    # way, rpc.py:280-322 MAX_RETRY 60 @ 3s)
+    import time as _time
+    last = None
+    for _ in range(max(retries, 1)):
+      try:
+        self._sock = socket.create_connection(self._addr,
+                                              timeout=self._timeout)
+        return
+      except OSError as e:
+        last = e
+        _time.sleep(interval)
+    raise ConnectionError(
+        f'could not connect to {self._addr}: {last}')
 
   def request(self, name: str, *args, **kwargs):
     with self._lock:

@@ -59,9 +59,7 @@ class LinkLoader(NodeLoader):
     self.edge_rows = eli[0].astype(np.int64)
     self.edge_cols = eli[1].astype(np.int64)
     self.edge_label = as_numpy(edge_label)
-    if isinstance(neg_sampling, dict):
-      neg_sampling = NegativeSampling(**neg_sampling)
-    self.neg_sampling = neg_sampling
+    self.neg_sampling = NegativeSampling.cast(neg_sampling)
     input_type = self.input_type
     super().__init__(data, sampler, input_nodes=np.arange(
         self.edge_rows.shape[0]), batch_size=batch_size, shuffle=shuffle,

@@ -101,6 +101,7 @@ class PartitionerBase:
         self._save_node_feat(nt, node_pbs[nt])
       meta = dict(num_parts=self.num_parts, data_cls='hetero',
                   edge_dir=self.edge_dir,
+                  edge_assign=self.edge_assign_strategy,
                   node_types=sorted(ntypes),
                   edge_types=[list(e) for e in self.edge_index])
     else:
@@ -110,7 +111,8 @@ class PartitionerBase:
                             {None: node_pb})
       self._save_node_feat(None, node_pb)
       meta = dict(num_parts=self.num_parts, data_cls='homo',
-                  edge_dir=self.edge_dir)
+                  edge_dir=self.edge_dir,
+                  edge_assign=self.edge_assign_strategy)
     with open(os.path.join(self.output_dir, 'META.json'), 'w') as f:
       json.dump(meta, f)
 

@@ -48,9 +48,14 @@ class NodeSamplerInput:
     return self
 
 
+from ..utils.common import CastMixin
+
+
 @dataclasses.dataclass
-class NegativeSampling:
-  """Binary or triplet negative sampling config (reference base.py:85-145)."""
+class NegativeSampling(CastMixin):
+  """Binary or triplet negative sampling config (reference base.py:85-145).
+  CastMixin lets callers pass a dict/tuple anywhere a NegativeSampling is
+  accepted (reference utils/mixin.py pattern)."""
   mode: str = 'binary'          # 'binary' | 'triplet'
   amount: Union[int, float] = 1
   strict: bool = False

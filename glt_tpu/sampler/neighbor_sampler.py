@@ -218,11 +218,10 @@ class NeighborSampler(BaseSampler):
       out[etype] = (row_t, col_t)
     return out
 
-  def _hetero_caps(self, batch_size: int, seed_type: NodeType):
+  def _hetero_caps(self, batch_sizes: Dict[NodeType, int]):
     """Static per-type frontier capacities and node budgets per hop."""
     trav = self._traversal_types()
-    caps = [{t: (batch_size if t == seed_type else 0)
-             for t in self._node_counts}]
+    caps = [{t: batch_sizes.get(t, 0) for t in self._node_counts}]
     for h in range(self.num_hops):
       nxt = {t: 0 for t in self._node_counts}
       for etype, (row_t, col_t) in trav.items():
@@ -233,16 +232,23 @@ class NeighborSampler(BaseSampler):
                for t in self._node_counts}
     return caps, budgets
 
-  def _build_hetero_fn(self, batch_size: int, seed_type: NodeType):
+  def _build_hetero_fn(self, batch_sizes: Dict[NodeType, int]):
+    """Multi-type seeding: ``batch_sizes`` gives each seed type's static
+    batch size (single-type node sampling passes one entry; two-type
+    link sampling passes both endpoint types)."""
     trav = self._traversal_types()
-    caps, budgets = self._hetero_caps(batch_size, seed_type)
+    caps, budgets = self._hetero_caps(batch_sizes)
+    seed_types = [t for t, b in batch_sizes.items() if b > 0]
 
     def fn(seeds, n_valid, key, tables):
+      # seeds / n_valid: dicts keyed by seed type
       states = {t: dense_init(tables[t][0], tables[t][1], budgets[t])
                 for t in self._node_counts}
-      seed_mask = jnp.arange(batch_size) < n_valid
-      states[seed_type], seed_labels = dense_assign(
-          states[seed_type], seeds, seed_mask)
+      seed_labels = {}
+      for t in seed_types:
+        mask = jnp.arange(batch_sizes[t]) < n_valid[t]
+        states[t], seed_labels[t] = dense_assign(states[t], seeds[t],
+                                                 mask)
 
       frontier = {
           t: (jax.lax.slice(states[t].nodes, (0,), (max(1, caps[0][t]),)),
@@ -319,7 +325,9 @@ class NeighborSampler(BaseSampler):
           row={e: jnp.concatenate(v) for e, v in rows_d.items()},
           col={e: jnp.concatenate(v) for e, v in cols_d.items()},
           edge_mask={e: jnp.concatenate(v) for e, v in mask_d.items()},
-          batch=jax.lax.slice(states[seed_type].nodes, (0,), (batch_size,)),
+          batch={t: jax.lax.slice(states[t].nodes, (0,),
+                                  (batch_sizes[t],))
+                 for t in seed_types},
           seed_labels=seed_labels,
           num_sampled_nodes={t: jnp.stack(v) for t, v in hop_nodes.items()},
           num_sampled_edges={e: jnp.stack(v) for e, v in hop_edges.items()},
@@ -333,23 +341,31 @@ class NeighborSampler(BaseSampler):
   def _hetero_sample_from_nodes(self, inputs, **kwargs) \
       -> HeteroSamplerOutput:
     if isinstance(inputs, NodeSamplerInput):
-      seeds = as_numpy(inputs.node)
+      seed_dict = {inputs.input_type: as_numpy(inputs.node)}
       seed_type = inputs.input_type
+    elif isinstance(inputs, dict):
+      seed_dict = {t: as_numpy(s) for t, s in inputs.items()}
+      seed_type = kwargs.pop('seed_type', next(iter(seed_dict)))
     else:
       seed_type, seeds = inputs
-      seeds = as_numpy(seeds)
+      seed_dict = {seed_type: as_numpy(seeds)}
     assert seed_type is not None, 'hetero sampling needs a seed node type'
-    n_valid = kwargs.get('n_valid', seeds.shape[0])
-    batch_size = seeds.shape[0]
-    cache_key = ('hetero', batch_size, seed_type)
+    n_valid = kwargs.get('n_valid')
+    if not isinstance(n_valid, dict):
+      n_valid = {t: (n_valid if n_valid is not None else s.shape[0])
+                 for t, s in seed_dict.items()}
+    batch_sizes = {t: s.shape[0] for t, s in seed_dict.items()}
+    cache_key = ('hetero', tuple(sorted(batch_sizes.items())))
     if cache_key not in self._fn_cache:
-      self._fn_cache[cache_key] = self._build_hetero_fn(
-          batch_size, seed_type)
+      self._fn_cache[cache_key] = self._build_hetero_fn(batch_sizes)
     tables = {t: self._get_tables(t, n)
               for t, n in self._node_counts.items()}
+    key = kwargs.pop('key', None)
     out, new_tables = self._fn_cache[cache_key](
-        jnp.asarray(seeds.astype(np.int32)), jnp.asarray(n_valid),
-        kwargs.get('key', self._next_key()), tables)
+        {t: jnp.asarray(s.astype(np.int32))
+         for t, s in seed_dict.items()},
+        {t: jnp.asarray(v) for t, v in n_valid.items()},
+        key if key is not None else self._next_key(), tables)
     self._tables.update(new_tables)
 
     # final keys: 'out' reverses the traversal type, 'in' keeps it; row
@@ -367,7 +383,7 @@ class NeighborSampler(BaseSampler):
     return HeteroSamplerOutput(
         node=out['node'], node_count=out['node_count'],
         row=row, col=col, edge_mask=edge_mask, edge=edge,
-        batch={seed_type: out['batch']},
+        batch=out['batch'],
         num_sampled_nodes=out['num_sampled_nodes'],
         num_sampled_edges=num_sampled_edges,
         input_type=seed_type,
@@ -433,16 +449,41 @@ class NeighborSampler(BaseSampler):
         dst = np.concatenate([dst, as_numpy(pair.cols)])
         assert edge_label is None
 
+    if input_type is not None and input_type[0] != input_type[-1]:
+      # two distinct endpoint types: seed both type spaces at once (the
+      # reference merges two sampler outputs, neighbor_sampler.py:376-398;
+      # our multi-type hetero engine seeds them natively)
+      src_t, _, dst_t = input_type
+      out = self._hetero_sample_from_nodes(
+          {src_t: src, dst_t: dst}, seed_type=src_t, key=key, **kwargs)
+      inverse_src = out.metadata['seed_labels'][src_t]
+      inverse_dst = out.metadata['seed_labels'][dst_t]
+      meta = dict(out.metadata or {})
+      if neg is None or neg.is_binary():
+        meta['edge_label_index'] = jnp.stack([inverse_src, inverse_dst])
+        meta['edge_label'] = (jnp.asarray(edge_label)
+                              if edge_label is not None else None)
+      else:
+        meta['src_index'] = inverse_src[:num_pos]
+        meta['dst_pos_index'] = inverse_dst[:num_pos]
+        dst_neg = inverse_dst[num_pos:]
+        if num_pos > 0 and num_neg // num_pos > 1:
+          dst_neg = dst_neg.reshape(num_pos, -1)
+        meta['dst_neg_index'] = dst_neg
+      meta['num_pos'] = num_pos
+      meta['num_neg'] = num_neg
+      out.metadata = meta
+      out.input_type = input_type
+      return out
+
     seeds = np.concatenate([src, dst])
     if input_type is not None:
-      assert input_type[0] == input_type[-1], (
-          'two-node-type link sampling is composed at the loader level; '
-          'pass same-type edge inputs here')
       out = self._hetero_sample_from_nodes(
           NodeSamplerInput(seeds, input_type[0]), key=key, **kwargs)
+      inverse = out.metadata['seed_labels'][input_type[0]]
     else:
       out = self.sample_from_nodes(seeds, key=key, **kwargs)
-    inverse = out.metadata['seed_labels']
+      inverse = out.metadata['seed_labels']
     meta = dict(out.metadata or {})
     if neg is None or neg.is_binary():
       meta['edge_label_index'] = inverse.reshape(2, -1)

@@ -47,3 +47,19 @@ def parse_size(size: object) -> int:
     raise ValueError(f'unknown size unit {unit!r}')
   scale = _UNITS.get(unit, 1)
   return int(float(num) * scale)
+
+
+class CastMixin:
+  """Construct from dict/tuple transparently (reference utils/mixin.py)."""
+
+  @classmethod
+  def cast(cls, *args, **kwargs):
+    if len(args) == 1 and len(kwargs) == 0:
+      elem = args[0]
+      if elem is None or isinstance(elem, cls):
+        return elem
+      if isinstance(elem, (tuple, list)):
+        return cls(*elem)
+      if isinstance(elem, dict):
+        return cls(**elem)
+    return cls(*args, **kwargs)

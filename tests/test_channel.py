@@ -132,3 +132,28 @@ def test_remote_receiving_channel():
   for m in got:
     per[int(m['sid'][0])].append(int(m['i'][0]))
   assert per[0] == list(range(5)) and per[1] == list(range(5))
+
+
+def test_table_dataset_from_csv(tmp_path):
+  from glt_tpu.data import TableDataset, csv_edge_reader
+  p = tmp_path / 'edges.csv'
+  p.write_text('0,1\n1,2\n2,0\n0,2\n')
+  ds = TableDataset(edge_dir='out')
+  ds.load(edge_reader=csv_edge_reader(str(p)), num_nodes=3)
+  g = ds.get_graph()
+  assert g.num_nodes == 3 and g.num_edges == 4
+  np.testing.assert_array_equal(g.degree(np.array([0, 1, 2])), [2, 1, 1])
+
+
+def test_table_dataset_node_reader():
+  from glt_tpu.data import TableDataset
+  def node_reader():
+    yield (np.array([0, 2]), np.array([[1.], [3.]], np.float32),
+           np.array([7, 9]))
+    yield (np.array([1]), np.array([[2.]], np.float32), np.array([8]))
+  ds = TableDataset()
+  ds.load(edge_reader=[(np.array([0, 1]), np.array([1, 2]))],
+          node_reader=node_reader(), num_nodes=3)
+  np.testing.assert_allclose(ds.get_node_feature()[np.arange(3)][:, 0],
+                             [1., 2., 3.])
+  np.testing.assert_array_equal(ds.get_node_label(), [7, 8, 9])

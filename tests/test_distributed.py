@@ -163,3 +163,92 @@ def test_dist_loader_and_train_step(mesh, part_dir, dist_datasets):
                                    jax.random.key(it))
     losses.append(float(np.asarray(loss)[0]))
   assert losses[-1] < losses[0], f'no learning: {losses[::8]}'
+
+
+def test_dist_hetero_sampler(tmp_path_factory, mesh):
+  from glt_tpu.distributed import DistHeteroGraph, DistHeteroNeighborSampler
+  # partition the hetero user/item fixture to disk
+  root = str(tmp_path_factory.mktemp('hetero_parts'))
+  u2i = ('user', 'u2i', 'item')
+  i2i = ('item', 'i2i', 'item')
+  nu, ni = 16, 32
+  u = np.arange(nu)
+  u2i_ei = np.stack([np.repeat(u, 2),
+                     np.stack([2*u, 2*u+1], 1).reshape(-1) % ni])
+  i = np.arange(ni)
+  i2i_ei = np.stack([np.repeat(i, 2),
+                     np.stack([(i+1) % ni, (i+2) % ni], 1).reshape(-1)])
+  RandomPartitioner(root, num_parts=N_PARTS,
+                    num_nodes={'user': nu, 'item': ni},
+                    edge_index={u2i: u2i_ei, i2i: i2i_ei}).partition()
+
+  dg = DistHeteroGraph.from_dataset_partitions(mesh, root)
+  s = DistHeteroNeighborSampler(dg, {u2i: [2, 2], i2i: [2, 2]}, seed=0)
+  seeds = (np.arange(N_PARTS) % nu)[:, None]   # one user per device
+  out = s.sample_from_nodes('user', seeds)
+  items = np.asarray(out['node']['item'])
+  users = np.asarray(out['node']['user'])
+  icount = np.asarray(out['node_count']['item'])
+  for p in range(N_PARTS):
+    uu = p % nu
+    np.testing.assert_array_equal(
+        users[p][:int(np.asarray(out['node_count']['user'])[p])], [uu])
+    # hop1 items {2u, 2u+1}; hop2 via i2i: +1, +2 of those
+    expect = {2*uu % ni, (2*uu+1) % ni}
+    for v in list(expect):
+      expect |= {(v+1) % ni, (v+2) % ni}
+    got = set(items[p][:icount[p]].tolist())
+    assert got == expect, f'dev {p}: {got} != {expect}'
+  # reversed etype keys present
+  assert ('item', 'rev_u2i', 'user') in out['row']
+
+
+def test_dist_hetero_train_step(tmp_path_factory, mesh):
+  import optax
+  from glt_tpu.distributed import (
+      DistDataset, DistFeature, DistHeteroGraph, DistHeteroTrainStep,
+  )
+  from glt_tpu.models import RGNN
+  from glt_tpu.typing import reverse_edge_type
+  root = str(tmp_path_factory.mktemp('hetero_train'))
+  u2i = ('user', 'u2i', 'item')
+  i2i = ('item', 'i2i', 'item')
+  nu, ni = 16, 32
+  u = np.arange(nu)
+  u2i_ei = np.stack([np.repeat(u, 2),
+                     np.stack([2*u, 2*u+1], 1).reshape(-1) % ni])
+  i = np.arange(ni)
+  i2i_ei = np.stack([np.repeat(i, 2),
+                     np.stack([(i+1) % ni, (i+2) % ni], 1).reshape(-1)])
+  w = max(nu, ni)
+  feats = {'user': np.pad(np.eye(nu, dtype=np.float32),
+                          ((0, 0), (0, w - nu))),
+           'item': np.pad(np.eye(ni, dtype=np.float32),
+                          ((0, 0), (0, w - ni)))}
+  RandomPartitioner(root, num_parts=N_PARTS,
+                    num_nodes={'user': nu, 'item': ni},
+                    edge_index={u2i: u2i_ei, i2i: i2i_ei},
+                    node_feat=feats).partition()
+  dg = DistHeteroGraph.from_dataset_partitions(mesh, root)
+  dss = [DistDataset().load(root, p) for p in range(N_PARTS)]
+  dfeats = {t: DistFeature.from_dist_datasets(mesh, dss, ntype=t)
+            for t in ('user', 'item')}
+  labels = {'user': (np.arange(nu) % 3).astype(np.int32)}
+  model = RGNN(edge_types=[reverse_edge_type(u2i), i2i],
+               hidden_features=16, out_features=3, num_layers=2,
+               conv='rsage')
+  tx = optax.adam(1e-2)
+  step = DistHeteroTrainStep(dg, dfeats, model, tx, labels,
+                             {u2i: [2, 2], i2i: [2, 2]},
+                             batch_size_per_device=2, seed_type='user',
+                             seed=0)
+  params = step.init_params(jax.random.key(0))
+  opt = tx.init(params)
+  rng = np.random.default_rng(0)
+  losses = []
+  for it in range(30):
+    seeds = rng.integers(0, nu, (N_PARTS, 2))
+    params, opt, loss = step(params, opt, seeds, np.full(N_PARTS, 2),
+                             jax.random.key(it))
+    losses.append(float(np.asarray(loss)[0]))
+  assert losses[-1] < losses[0], f'no learning: {losses[::6]}'

@@ -82,3 +82,51 @@ def test_subgraph_loader(ring):
   for p, c in zip(parent, child):
     assert c in ((p + 1) % 40, (p + 2) % 40)
   np.testing.assert_allclose(np.asarray(b.x)[:nc, 0], nodes)
+
+
+def test_bipartite_link_sampling():
+  """Two-type (user->item) link sampling: the bipartite_sage_unsup
+  workload shape. Seeds both type spaces in one call."""
+  from fixtures import hetero_ring_dataset
+  ds = hetero_ring_dataset(num_users=10, num_items=20)
+  u2i = ('user', 'u2i', 'item')
+  i2i = ('item', 'i2i', 'item')
+  s = NeighborSampler(ds.graph, {u2i: [2], i2i: [2]}, seed=0)
+  rows = np.array([0, 1, 2, 3])          # users
+  cols = (2 * rows) % 20                 # their items
+  out = s.sample_from_edges(EdgeSamplerInput(
+      rows, cols, input_type=u2i,
+      neg_sampling=NegativeSampling('binary', amount=1)))
+  meta = out.metadata
+  eli = np.asarray(meta['edge_label_index'])
+  assert eli.shape == (2, 8)
+  users = np.asarray(out.node['user'])
+  items = np.asarray(out.node['item'])
+  np.testing.assert_array_equal(users[eli[0, :4]], rows)
+  np.testing.assert_array_equal(items[eli[1, :4]], cols)
+  np.testing.assert_array_equal(np.asarray(meta['edge_label']),
+                                [1, 1, 1, 1, 0, 0, 0, 0])
+  # negatives live in valid id spaces
+  assert users[eli[0, 4:]].max() < 10
+  assert items[eli[1, 4:]].max() < 20
+
+
+def test_bipartite_triplet_sampling():
+  from fixtures import hetero_ring_dataset
+  ds = hetero_ring_dataset(num_users=10, num_items=20)
+  u2i = ('user', 'u2i', 'item')
+  i2i = ('item', 'i2i', 'item')
+  s = NeighborSampler(ds.graph, {u2i: [2], i2i: [2]}, seed=1)
+  rows = np.array([4, 5])
+  cols = (2 * rows) % 20
+  out = s.sample_from_edges(EdgeSamplerInput(
+      rows, cols, input_type=u2i,
+      neg_sampling=NegativeSampling('triplet', amount=3)))
+  meta = out.metadata
+  users = np.asarray(out.node['user'])
+  items = np.asarray(out.node['item'])
+  np.testing.assert_array_equal(users[np.asarray(meta['src_index'])],
+                                rows)
+  np.testing.assert_array_equal(items[np.asarray(meta['dst_pos_index'])],
+                                cols)
+  assert np.asarray(meta['dst_neg_index']).shape == (2, 3)
