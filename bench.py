@@ -53,7 +53,9 @@ def main():
   one_hop = lambda ids, fanout, key, mask: sample_neighbors(
       indptr, indices, ids, fanout, key, seed_mask=mask)
 
-  @jax.jit
+  import functools
+
+  @functools.partial(jax.jit, donate_argnums=(2, 3))
   def sample_batch(seeds, key, table, scratch):
     out, table, scratch = multihop_sample(
         one_hop, seeds, jnp.asarray(BATCH), FANOUT, key, table, scratch)

@@ -1,0 +1,71 @@
+"""Feature-gather throughput benchmark.
+
+Reference protocol: benchmarks/api/bench_feature.py (--split_ratio=0.2,
+prints lookup throughput on random ids). Measures the two residency
+paths: device-resident gather (HBM) and the hot/cold split with host
+spill (the UVA analogue). Prints one JSON line per config.
+"""
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--num-rows', type=int, default=2_000_000)
+  ap.add_argument('--dim', type=int, default=128)
+  ap.add_argument('--batch', type=int, default=200_000)
+  ap.add_argument('--iters', type=int, default=30)
+  ap.add_argument('--split-ratio', type=float, default=0.2)
+  args = ap.parse_args()
+
+  import jax
+  import jax.numpy as jnp
+  from glt_tpu.data import Feature
+
+  rng = np.random.default_rng(0)
+  feats = rng.normal(size=(args.num_rows, args.dim)).astype(np.float32)
+
+  # path 1: fully device resident
+  f_dev = Feature(feats, split_ratio=1.0)
+  f_dev.lazy_init()
+  gather = jax.jit(lambda rows: f_dev.device_gather(rows))
+  ids = jnp.asarray(rng.integers(0, args.num_rows, args.batch))
+  gather(ids).block_until_ready()
+  t0 = time.time()
+  out = None
+  for i in range(args.iters):
+    out = gather(ids)
+  out.block_until_ready()
+  dt = time.time() - t0
+  rate = args.batch * args.iters / dt
+  print(json.dumps({
+      'metric': 'feature_gather_rows_per_sec_device',
+      'value': round(rate, 1), 'unit': 'rows/s',
+      'vs_baseline': None}))
+
+  # path 2: hot/cold split (degree-ordered hot prefix assumed)
+  f_split = Feature(feats, split_ratio=args.split_ratio)
+  f_split.lazy_init()
+  # 80% of requests hit the hot prefix (cache-friendly skew)
+  hot = rng.integers(0, int(args.num_rows * args.split_ratio),
+                     int(args.batch * 0.8))
+  cold = rng.integers(int(args.num_rows * args.split_ratio),
+                      args.num_rows, args.batch - hot.shape[0])
+  ids_np = np.concatenate([hot, cold])
+  rng.shuffle(ids_np)
+  t0 = time.time()
+  for i in range(args.iters):
+    out = f_split[ids_np]
+  dt = time.time() - t0
+  rate = args.batch * args.iters / dt
+  print(json.dumps({
+      'metric': 'feature_gather_rows_per_sec_split',
+      'value': round(rate, 1), 'unit': 'rows/s',
+      'vs_baseline': None}))
+
+
+if __name__ == '__main__':
+  main()

@@ -1,0 +1,97 @@
+"""End-to-end training benchmark: GraphSAGE epoch time (the reference's
+train_sage_ogbn_products.py protocol — fanout [15,10,5], batch 1024,
+3 layers, hidden 256 — on a synthetic products-scale graph).
+
+Prints one JSON line: epoch seconds + sampled-edge throughput.
+"""
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--num-nodes', type=int, default=2_450_000)
+  ap.add_argument('--avg-degree', type=int, default=25)
+  ap.add_argument('--feat-dim', type=int, default=100)
+  ap.add_argument('--batch-size', type=int, default=1024)
+  ap.add_argument('--fanout', default='15,10,5')
+  ap.add_argument('--hidden', type=int, default=256)
+  ap.add_argument('--max-steps', type=int, default=0,
+                  help='cap steps per epoch (0 = full epoch)')
+  args = ap.parse_args()
+
+  import jax
+  import jax.numpy as jnp
+  import optax
+  from glt_tpu.data import Dataset
+  from glt_tpu.loader import NeighborLoader
+  from glt_tpu.models import GraphSAGE
+
+  rng = np.random.default_rng(0)
+  n = args.num_nodes
+  e = n * args.avg_degree
+  src = rng.integers(0, n, e, dtype=np.int64)
+  dst = (rng.random(e) ** 2 * n).astype(np.int64) % n
+  feats = rng.normal(size=(n, args.feat_dim)).astype(np.float32)
+  w = rng.normal(size=(args.feat_dim, 47)).astype(np.float32)
+  labels = np.argmax(feats @ w, 1).astype(np.int32)
+  ds = Dataset(edge_dir='out')
+  ds.init_graph(edge_index=np.stack([src, dst]), num_nodes=n)
+  del src, dst
+  ds.init_node_features(feats)
+  ds.init_node_labels(labels)
+  train_idx = rng.permutation(n)[: int(n * 0.1)]
+
+  fanout = [int(x) for x in args.fanout.split(',')]
+  loader = NeighborLoader(ds, fanout, input_nodes=train_idx,
+                          batch_size=args.batch_size, shuffle=True,
+                          drop_last=True, seed=0)
+  model = GraphSAGE(hidden_features=args.hidden, out_features=47,
+                    num_layers=len(fanout))
+  b0 = next(iter(loader))
+  params = model.init(jax.random.key(0), b0)
+  tx = optax.adam(1e-3)
+  opt = tx.init(params)
+
+  @jax.jit
+  def step(params, opt, batch):
+    def loss_fn(p):
+      logits = model.apply(p, batch)
+      l = optax.softmax_cross_entropy_with_integer_labels(logits, batch.y)
+      return l.mean()
+    loss, g = jax.value_and_grad(loss_fn)(params)
+    up, opt = tx.update(g, opt)
+    return optax.apply_updates(params, up), opt, loss
+
+  # warmup/compile
+  params, opt, loss = step(params, opt, b0)
+  jax.block_until_ready(loss)
+
+  t0 = time.time()
+  steps = 0
+  edges = 0
+  for batch in loader:
+    params, opt, loss = step(params, opt, batch)
+    edges += int(np.asarray(jnp.sum(batch.num_sampled_edges)))
+    steps += 1
+    if args.max_steps and steps >= args.max_steps:
+      break
+  jax.block_until_ready(loss)
+  dt = time.time() - t0
+  full_epoch_est = dt * (len(loader) / max(steps, 1))
+  print(json.dumps({
+      'metric': 'sage_products_epoch_seconds',
+      'value': round(full_epoch_est, 2),
+      'unit': 's',
+      'vs_baseline': None,
+      'detail': {'steps_timed': steps, 'seconds': round(dt, 2),
+                 'sampled_edges_per_sec': round(edges / dt, 1),
+                 'final_loss': float(loss)},
+  }))
+
+
+if __name__ == '__main__':
+  main()

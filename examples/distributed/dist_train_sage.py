@@ -19,7 +19,9 @@ def main():
   ap = argparse.ArgumentParser()
   ap.add_argument('--num-devices', type=int, default=8)
   ap.add_argument('--steps', type=int, default=30)
-  ap.add_argument('--cpu-mesh', action='store_true', default=True)
+  ap.add_argument('--cpu-mesh', action=argparse.BooleanOptionalAction,
+                  default=True,
+                  help='--no-cpu-mesh runs on the real device mesh')
   args = ap.parse_args()
 
   if args.cpu_mesh:

@@ -1,0 +1,54 @@
+"""Feature store shared across processes — the reference's
+examples/feature_mp.py (Feature IPC via CUDA handles). The TPU analogue
+ships feature *lookups* between processes through the native shm
+channel: a worker process resolves rows from its copy and streams them
+back (the pattern the mp sampling workers use for collected features)."""
+import multiprocessing as mp
+import os
+import sys
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), '..'))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def feature_worker(chan_req, chan_resp):
+  import jax
+  try:
+    jax.config.update('jax_platforms', 'cpu')
+  except Exception:
+    pass
+  from glt_tpu.data import Feature
+  rng = np.random.default_rng(0)
+  feats = rng.normal(size=(1000, 16)).astype(np.float32)
+  f = Feature(feats, split_ratio=0.5)
+  while True:
+    msg = chan_req.recv(timeout_ms=30_000)
+    if '#EXIT' in msg:
+      break
+    chan_resp.send({'rows': f[msg['ids']]})
+
+
+def main():
+  from glt_tpu.channel import ShmChannel
+  chan_req = ShmChannel(capacity_bytes=1 << 20)
+  chan_resp = ShmChannel(capacity_bytes=1 << 22)
+  p = mp.get_context('spawn').Process(
+      target=feature_worker, args=(chan_req, chan_resp))
+  p.start()
+  rng = np.random.default_rng(1)
+  for i in range(5):
+    ids = rng.integers(0, 1000, 64)
+    chan_req.send({'ids': ids})
+    out = chan_resp.recv(timeout_ms=30_000)
+    print(f'batch {i}: got {out["rows"].shape} rows')
+  chan_req.send({'#EXIT': np.array([1])})
+  p.join(timeout=15)
+  chan_req.close()
+  chan_resp.close()
+  print('done')
+
+
+if __name__ == '__main__':
+  main()

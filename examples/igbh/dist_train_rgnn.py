@@ -22,7 +22,9 @@ def main():
   ap.add_argument('--steps', type=int, default=30)
   ap.add_argument('--fanout', default='5,5')
   ap.add_argument('--batch-size', type=int, default=64)
-  ap.add_argument('--cpu-mesh', action='store_true', default=True)
+  ap.add_argument('--cpu-mesh', action=argparse.BooleanOptionalAction,
+                  default=True,
+                  help='--no-cpu-mesh runs on the real device mesh')
   args = ap.parse_args()
 
   if args.cpu_mesh:

@@ -2,7 +2,7 @@ from .dist_context import (
     DistContext, DistRole, assign_server_by_order, get_context,
     init_client_context, init_server_context, init_worker_group, shutdown,
 )
-from .dist_dataset import DistDataset
+from .dist_dataset import DistDataset, DistTableDataset
 from .dist_graph import DistGraph
 from .dist_feature import DistFeature
 from .dist_neighbor_sampler import DistNeighborSampler
@@ -11,7 +11,8 @@ __all__ = [
     'DistContext', 'DistRole', 'assign_server_by_order', 'get_context',
     'init_client_context', 'init_server_context', 'init_worker_group',
     'shutdown',
-    'DistDataset', 'DistGraph', 'DistFeature', 'DistNeighborSampler',
+    'DistDataset', 'DistTableDataset', 'DistGraph', 'DistFeature',
+    'DistNeighborSampler',
 ]
 from .dist_train import DistTrainStep
 from .dist_loader import DistNeighborLoader

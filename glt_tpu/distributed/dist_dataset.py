@@ -124,3 +124,54 @@ class DistDataset(Dataset):
     if isinstance(self.node_pb, dict) and ntype is not None:
       return self.node_pb[ntype]
     return self.node_pb
+
+
+class DistTableDataset(DistDataset):
+  """Distributed table loading (reference
+  distributed/dist_table_dataset.py:149): each rank streams its table
+  slice through readers, then partitions online via
+  DistRandomPartitioner. Thin composition over TableDataset readers."""
+
+  def load_tables(self, edge_reader, node_reader, rank: int,
+                  world_size: int, num_nodes: int, output_dir: str,
+                  edge_id_offset: int = 0,
+                  master_addr: str = '127.0.0.1',
+                  master_port: int = 30800,
+                  peer_addrs=None) -> 'DistTableDataset':
+    """Stream this rank's table slices and partition online.
+
+    Readers feed RAW slices (no densification): edge records become this
+    rank's edge slice with GLOBAL edge ids ``edge_id_offset + local
+    position`` (ranks must pass disjoint offsets, e.g. exclusive prefix
+    sums of their row counts — the reference's table sharding gives each
+    worker a disjoint row range the same way); node records contribute
+    exactly the (ids, rows) the reader produced.
+    """
+    from .dist_random_partitioner import DistRandomPartitioner
+    srcs, dsts = [], []
+    if edge_reader is not None:
+      for rec in edge_reader:
+        srcs.append(as_numpy(rec[0]).astype(np.int64))
+        dsts.append(as_numpy(rec[1]).astype(np.int64))
+    src = np.concatenate(srcs) if srcs else np.zeros(0, np.int64)
+    dst = np.concatenate(dsts) if dsts else np.zeros(0, np.int64)
+    eids = edge_id_offset + np.arange(src.shape[0], dtype=np.int64)
+    ids_l, feats_l = [], []
+    if node_reader is not None:
+      for rec in node_reader:
+        ids_l.append(as_numpy(rec[0]).astype(np.int64))
+        feats_l.append(as_numpy(rec[1]))
+    node_ids = np.concatenate(ids_l) if ids_l else None
+    node_feat = np.concatenate(feats_l) if feats_l else None
+    partitioner = DistRandomPartitioner(
+        output_dir, rank=rank, world_size=world_size,
+        num_nodes=num_nodes,
+        edge_slice=np.stack([src, dst]), eid_slice=eids,
+        node_ids=node_ids, node_feat=node_feat,
+        master_addr=master_addr, master_port=master_port,
+        peer_addrs=peer_addrs)
+    try:
+      partitioner.partition()
+    finally:
+      partitioner.shutdown()
+    return self.load(output_dir, rank)

@@ -20,9 +20,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.unique import dense_assign, dense_init, dense_make_tables, \
-    dense_reset
-from ..parallel.collectives import all_to_all, bucket_by_owner, unbucket
+from ..ops.pipeline import multihop_sample_hetero
+from ..ops.unique import dense_make_tables
 from ..sampler.base import HeteroSamplerOutput
 from ..typing import EdgeType, NodeType, reverse_edge_type
 from ..utils import as_numpy
@@ -237,89 +236,16 @@ class DistHeteroNeighborSampler:
                  node_pb=sh['node_pb']),
             g.graphs[e].num_nodes, n_parts, g.graphs[e].max_rows, axis)
 
-      states = {t: dense_init(tables[t][0], tables[t][1],
-                              budgets[t]) for t in types}
-      seed_mask = jnp.arange(batch_size) < n_valid
-      states[seed_type], seed_labels = dense_assign(
-          states[seed_type], seeds, seed_mask)
-      frontier = {}
-      for t in types:
-        c0 = max(1, caps[0][t])
-        labels = jnp.arange(c0, dtype=jnp.int32)
-        frontier[t] = (jax.lax.slice(states[t].nodes, (0,), (c0,)),
-                       labels, labels < states[t].count)
-
-      rows_d, cols_d, mask_d, eid_d = {}, {}, {}, {}
-      hop_nodes = {t: [states[t].count] for t in types}
-      hop_edges = {}
-      for h in range(self.num_hops):
-        per_type_nbrs = {t: [] for t in types}
-        per_meta = []
-        for e, (row_t, col_t) in trav.items():
-          k = self.num_neighbors[e][h]
-          if caps[h][row_t] == 0 or k == 0:
-            continue
-          f_ids, f_labels, f_mask = frontier[row_t]
-          key, sub = jax.random.split(key)
-          out = one_hops[e](f_ids, k, sub, f_mask)
-          per_type_nbrs[col_t].append(
-              (out.nbrs.reshape(-1), out.mask.reshape(-1)))
-          per_meta.append((e, col_t, jnp.repeat(f_labels, k),
-                           out.mask.reshape(-1),
-                           out.eids.reshape(-1) if self.with_edge
-                           else None,
-                           caps[h][row_t] * k))
-        prev = {t: states[t].count for t in types}
-        labels_by_type = {}
-        for t, chunks in per_type_nbrs.items():
-          if not chunks:
-            continue
-          ids = jnp.concatenate([c[0] for c in chunks])
-          ok = jnp.concatenate([c[1] for c in chunks])
-          states[t], labels = dense_assign(states[t], ids, ok)
-          labels_by_type[t] = labels
-        cursor = {t: 0 for t in types}
-        for e, col_t, rows_parent, mask, eids, width in per_meta:
-          s = cursor[col_t]
-          cursor[col_t] += width
-          lab = jax.lax.slice(labels_by_type[col_t], (s,), (s + width,))
-          rows_d.setdefault(e, []).append(rows_parent)
-          cols_d.setdefault(e, []).append(lab)
-          mask_d.setdefault(e, []).append(mask)
-          if self.with_edge:
-            eid_d.setdefault(e, []).append(eids)
-          hop_edges.setdefault(e, []).append(mask.sum().astype(jnp.int32))
-        for t in types:
-          cap_next = max(1, caps[h + 1][t])
-          labels = prev[t] + jnp.arange(cap_next, dtype=jnp.int32)
-          frontier[t] = (
-              jnp.take(states[t].nodes,
-                       jnp.minimum(labels, budgets[t])),
-              labels, labels < states[t].count)
-          hop_nodes[t].append(states[t].count - prev[t])
-
-      out_tables = {}
-      for t in types:
-        out_tables[t] = dense_reset(states[t])
-      result = dict(
-          node={t: jax.lax.slice(states[t].nodes, (0,),
-                                 (budgets[t],)) for t in types},
-          node_count={t: states[t].count for t in types},
-          row={e: jnp.concatenate(v) for e, v in rows_d.items()},
-          col={e: jnp.concatenate(v) for e, v in cols_d.items()},
-          edge_mask={e: jnp.concatenate(v)
-                     for e, v in mask_d.items()},
-          batch=jax.lax.slice(states[seed_type].nodes, (0,),
-                              (batch_size,)),
-          seed_labels=seed_labels,
-          num_sampled_nodes={t: jnp.stack(v)
-                             for t, v in hop_nodes.items()},
-          num_sampled_edges={e: jnp.stack(v)
-                             for e, v in hop_edges.items()},
-      )
-      if self.with_edge:
-        result['edge'] = {e: jnp.concatenate(v)
-                          for e, v in eid_d.items()}
+      trav_active = {e: trav[e] for e in etypes}
+      result, out_tables = multihop_sample_hetero(
+          one_hops, trav_active, self.num_neighbors, self.num_hops,
+          caps, budgets, {seed_type: seeds},
+          {seed_type: n_valid}, key, tables,
+          with_edge=self.with_edge)
+      # flatten the per-seed-type dicts to the flat fields dist callers
+      # consume (single seed type in dist mode)
+      result['batch'] = result['batch'][seed_type]
+      result['seed_labels'] = result['seed_labels'][seed_type]
       return result, out_tables
 
     return device_core, caps, budgets, etypes

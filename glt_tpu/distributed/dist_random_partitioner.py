@@ -67,7 +67,9 @@ class DistRandomPartitioner:
                num_nodes: int, edge_slice, eid_slice,
                node_ids=None, node_feat=None,
                master_addr: str = '127.0.0.1', master_port: int = 30500,
-               chunk_size: int = CHUNK, seed: int = 0):
+               chunk_size: int = CHUNK, seed: int = 0,
+               bind_addr: str = '0.0.0.0',
+               peer_addrs: Optional[List[str]] = None):
     self.output_dir = output_dir
     self.rank = int(rank)
     self.world = int(world_size)
@@ -79,16 +81,21 @@ class DistRandomPartitioner:
     self.chunk_size = int(chunk_size)
     self.seed = seed
     self.buffer = _PartitionBuffer()
-    self.server = RpcServer(master_addr, master_port + rank)
+    # bind locally (0.0.0.0 works on any host); peers are reached at
+    # their own addresses — multi-host needs peer_addrs, single host
+    # defaults every peer to master_addr
+    self.server = RpcServer(bind_addr, master_port + rank)
     self.server.register('push_edges', self.buffer.push_edges)
     self.server.register('push_node_feat', self.buffer.push_node_feat)
-    self.addr = master_addr
+    self.peer_addrs = peer_addrs or [master_addr] * world_size
+    assert len(self.peer_addrs) == world_size
     self.base_port = master_port
     self._clients: Dict[int, RpcClient] = {}
 
   def _client(self, peer: int) -> RpcClient:
     if peer not in self._clients:
-      self._clients[peer] = RpcClient(self.addr, self.base_port + peer)
+      self._clients[peer] = RpcClient(self.peer_addrs[peer],
+                                      self.base_port + peer)
     return self._clients[peer]
 
   def _owner_of(self, ids: np.ndarray) -> np.ndarray:
@@ -177,7 +184,10 @@ class DistRandomPartitioner:
       z = np.load(os.path.join(self.output_dir, f'part{r}', 'graph',
                                'data.npz'))
       chunks.append((z['eids'], r))
-    total = sum(c[0].shape[0] for c in chunks)
+    # size by the global id space (ids are disjoint but need not be a
+    # compact 0..E-1 range if a rank contributed nothing)
+    total = max((int(c[0].max()) + 1 for c in chunks if c[0].size),
+                default=0)
     edge_pb = np.zeros(total, np.int32)
     for eids, r in chunks:
       edge_pb[eids] = r

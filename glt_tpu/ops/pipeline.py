@@ -97,3 +97,116 @@ def multihop_sample(one_hop: OneHopFn,
   if with_edge:
     out_dict['edge'] = jnp.concatenate(eid_list)
   return out_dict, table, scratch
+
+
+def hetero_edge_capacities(caps, trav, num_neighbors, num_hops):
+  """Per-etype total edge-slot capacity across hops."""
+  out = {}
+  for e, (row_t, _) in trav.items():
+    out[e] = sum(caps[h][row_t] * num_neighbors[e][h]
+                 for h in range(num_hops))
+  return out
+
+
+def multihop_sample_hetero(one_hops, trav, num_neighbors, num_hops,
+                           caps, budgets, seeds, n_valid, key, tables,
+                           with_edge: bool = False):
+  """Hetero hop loop shared by the single-device engine and the SPMD
+  distributed engine (only the per-edge-type ``one_hops`` differ:
+  in-HBM sampling vs the all_to_all collective version).
+
+  Args:
+    one_hops: Dict[EdgeType, OneHopFn].
+    trav: Dict[EdgeType, (expand_from_type, neighbor_type)].
+    num_neighbors: Dict[EdgeType, List[int]].
+    caps/budgets: static per-hop frontier capacities / node budgets per
+      node type (callers compute them identically from trav).
+    seeds/n_valid: Dict[NodeType, array] — multi-type seeding.
+    tables: Dict[NodeType, (table, scratch)].
+
+  Returns (result dict, out_tables) with per-type node lists, per-etype
+  row(parent)/col(child) label buffers in traversal orientation, batch
+  and seed_labels dicts, per-hop counts. Tables come back reset.
+  """
+  from .unique import dense_assign, dense_init, dense_reset
+  types = list(budgets)
+  states = {t: dense_init(tables[t][0], tables[t][1], budgets[t])
+            for t in types}
+  seed_labels = {}
+  for t, s in seeds.items():
+    mask = jnp.arange(s.shape[0]) < n_valid[t]
+    states[t], seed_labels[t] = dense_assign(states[t], s, mask)
+
+  frontier = {}
+  for t in types:
+    c0 = max(1, caps[0][t])
+    labels = jnp.arange(c0, dtype=jnp.int32)
+    frontier[t] = (jax.lax.slice(states[t].nodes, (0,), (c0,)),
+                   labels, labels < states[t].count)
+
+  rows_d, cols_d, mask_d, eid_d = {}, {}, {}, {}
+  hop_nodes = {t: [states[t].count] for t in types}
+  hop_edges = {}
+  for h in range(num_hops):
+    per_type_nbrs = {t: [] for t in types}
+    per_meta = []
+    for e, (row_t, col_t) in trav.items():
+      k = num_neighbors[e][h]
+      if caps[h][row_t] == 0 or k == 0:
+        continue
+      f_ids, f_labels, f_mask = frontier[row_t]
+      key, sub = jax.random.split(key)
+      out = one_hops[e](f_ids, k, sub, f_mask)
+      per_type_nbrs[col_t].append(
+          (out.nbrs.reshape(-1), out.mask.reshape(-1)))
+      per_meta.append((e, col_t, jnp.repeat(f_labels, k),
+                       out.mask.reshape(-1),
+                       out.eids.reshape(-1) if with_edge else None,
+                       caps[h][row_t] * k))
+    prev = {t: states[t].count for t in types}
+    labels_by_type = {}
+    for t, chunks in per_type_nbrs.items():
+      if not chunks:
+        continue
+      ids = jnp.concatenate([c[0] for c in chunks])
+      ok = jnp.concatenate([c[1] for c in chunks])
+      states[t], labels = dense_assign(states[t], ids, ok)
+      labels_by_type[t] = labels
+    cursor = {t: 0 for t in types}
+    for e, col_t, rows_parent, mask, eids, width in per_meta:
+      s = cursor[col_t]
+      cursor[col_t] += width
+      lab = jax.lax.slice(labels_by_type[col_t], (s,), (s + width,))
+      rows_d.setdefault(e, []).append(rows_parent)
+      cols_d.setdefault(e, []).append(lab)
+      mask_d.setdefault(e, []).append(mask)
+      if with_edge:
+        eid_d.setdefault(e, []).append(eids)
+      hop_edges.setdefault(e, []).append(mask.sum().astype(jnp.int32))
+    for t in types:
+      cap_next = max(1, caps[h + 1][t])
+      labels = prev[t] + jnp.arange(cap_next, dtype=jnp.int32)
+      frontier[t] = (
+          jnp.take(states[t].nodes, jnp.minimum(labels, budgets[t])),
+          labels, labels < states[t].count)
+      hop_nodes[t].append(states[t].count - prev[t])
+
+  out_tables = {}
+  for t in types:
+    out_tables[t] = dense_reset(states[t])
+  result = dict(
+      node={t: jax.lax.slice(states[t].nodes, (0,), (budgets[t],))
+            for t in types},
+      node_count={t: states[t].count for t in types},
+      row={e: jnp.concatenate(v) for e, v in rows_d.items()},
+      col={e: jnp.concatenate(v) for e, v in cols_d.items()},
+      edge_mask={e: jnp.concatenate(v) for e, v in mask_d.items()},
+      batch={t: jax.lax.slice(states[t].nodes, (0,),
+                              (seeds[t].shape[0],)) for t in seeds},
+      seed_labels=seed_labels,
+      num_sampled_nodes={t: jnp.stack(v) for t, v in hop_nodes.items()},
+      num_sampled_edges={e: jnp.stack(v) for e, v in hop_edges.items()},
+  )
+  if with_edge:
+    result['edge'] = {e: jnp.concatenate(v) for e, v in eid_d.items()}
+  return result, out_tables

@@ -1,0 +1,62 @@
+"""Multi-host (DCN) helpers.
+
+The reference scales out with one process per machine joined by
+TensorPipe/NCCL rendezvous (SURVEY.md §2.3). The TPU equivalent is
+jax.distributed: one process per host, devices fused into one global
+mesh, ICI within a slice and DCN across slices handled by XLA. These
+helpers cover the two framework needs:
+
+  * initialize() — process-group bootstrap (MASTER_ADDR-style envs or
+    explicit coordinator), safe to call once per process.
+  * global_from_local(mesh, local, axis) — assemble a mesh-sharded
+    global array where THIS process contributes only its local block
+    (jax.make_array_from_process_local_data), so a DistGraph/DistFeature
+    can be built per-host from that host's partition only — no rank
+    ever materializes the whole graph, exactly like the reference's
+    per-rank partition loading.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+  """Bootstrap jax.distributed (no-op for a single process)."""
+  if num_processes in (None, 1) and coordinator_address is None:
+    return
+  jax.distributed.initialize(
+      coordinator_address=coordinator_address,
+      num_processes=num_processes, process_id=process_id)
+
+
+def process_mesh_info(mesh: Mesh, axis: str = 'data'):
+  """(num_shards, shards_owned_by_this_process) along ``axis``."""
+  n = mesh.shape[axis]
+  devices = mesh.devices.reshape(-1)
+  mine = [i for i, d in enumerate(devices)
+          if d.process_index == jax.process_index()]
+  return n, mine
+
+
+def global_from_local(mesh: Mesh, local: np.ndarray, axis: str = 'data'):
+  """Build the [n_shards, ...] mesh-sharded stack where this process
+  supplies blocks only for its own devices.
+
+  ``local``: [n_local_shards, ...] — this process's blocks, ordered by
+  its device order along the axis. Single-process: equals a plain
+  device_put of the full stack.
+  """
+  sharding = NamedSharding(mesh, P(axis))
+  if jax.process_count() == 1:
+    return jax.device_put(local, sharding)
+  n = mesh.shape[axis]
+  global_shape = (n,) + tuple(local.shape[1:])
+  return jax.make_array_from_process_local_data(
+      sharding, local, global_shape=global_shape)

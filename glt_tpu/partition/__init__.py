@@ -4,6 +4,7 @@ from .partition_book import (
 )
 from .base import (
     PartitionerBase, load_partition, load_meta, cat_feature_cache,
+    build_partition_feature,
 )
 from .random_partitioner import RandomPartitioner
 from .frequency_partitioner import FrequencyPartitioner
@@ -12,5 +13,6 @@ __all__ = [
     'PartitionBook', 'RangePartitionBook', 'TablePartitionBook',
     'infer_partition_book',
     'PartitionerBase', 'load_partition', 'load_meta', 'cat_feature_cache',
+    'build_partition_feature',
     'RandomPartitioner', 'FrequencyPartitioner',
 ]

@@ -277,3 +277,39 @@ def cat_feature_cache(part: int, feat: FeaturePartitionData,
   id2index = np.full(max(max_id, table.shape[0]), -1, np.int64)
   id2index[ids] = np.arange(ids.shape[0])
   return feats, ids, id2index, TablePartitionBook(table)
+
+
+def build_partition_feature(root_dir: str, node_feat, ntype=None,
+                            cache_probs=None, cache_ratio: float = 0.0
+                            ) -> None:
+  """Two-stage partitioning, stage 2 (reference partition/base.py:585-703
+  + examples/igbh/build_partition_feature.py): given an already-saved
+  topology partitioning (node PBs on disk), extract and save each
+  partition's feature rows — used when features are too large to
+  partition together with the topology.
+  """
+  meta = load_meta(root_dir)
+  from ..utils import as_numpy
+  node_feat = as_numpy(node_feat)
+  if meta['data_cls'] == 'hetero':
+    assert ntype is not None
+    pb = np.load(os.path.join(root_dir, 'node_pb', f'{ntype}.npy'))
+  else:
+    pb = np.load(os.path.join(root_dir, 'node_pb.npy'))
+  probs = as_numpy(cache_probs)
+  cache_num = int(pb.shape[0] * cache_ratio) if cache_ratio else 0
+  for p in range(meta['num_parts']):
+    ids = np.nonzero(pb == p)[0]
+    payload = dict(feats=node_feat[ids], ids=ids)
+    if cache_num and probs is not None:
+      score = probs.copy()
+      score[ids] = -1.0
+      hot = np.argsort(-score)[:cache_num]
+      hot = hot[score[hot] > 0]
+      if hot.size:
+        payload['cache_feats'] = node_feat[hot]
+        payload['cache_ids'] = hot
+    d = os.path.join(root_dir, f'part{p}', 'node_feat')
+    os.makedirs(d, exist_ok=True)
+    np.savez(os.path.join(d, f'{ntype}.npz') if ntype
+             else os.path.join(d, 'data.npz'), **payload)

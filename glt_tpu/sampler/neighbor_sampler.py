@@ -26,7 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..data import Dataset, Graph
-from ..ops.pipeline import edge_hop_offsets, multihop_sample
+from ..ops.pipeline import edge_hop_offsets, multihop_sample, \
+    multihop_sample_hetero
 from ..ops.sample import sample_neighbors, sample_neighbors_weighted, \
     neighbor_probs
 from ..ops.subgraph import induced_subgraph
@@ -235,106 +236,20 @@ class NeighborSampler(BaseSampler):
   def _build_hetero_fn(self, batch_sizes: Dict[NodeType, int]):
     """Multi-type seeding: ``batch_sizes`` gives each seed type's static
     batch size (single-type node sampling passes one entry; two-type
-    link sampling passes both endpoint types)."""
+    link sampling passes both endpoint types). The hop loop itself is
+    the shared ops.pipeline.multihop_sample_hetero core."""
     trav = self._traversal_types()
     caps, budgets = self._hetero_caps(batch_sizes)
-    seed_types = [t for t, b in batch_sizes.items() if b > 0]
+    one_hops = {
+        e: (lambda ids, fanout, key, mask, _e=e: self._one_hop(
+            self.graph[_e], ids, fanout, key, mask))
+        for e in self.edge_types}
 
     def fn(seeds, n_valid, key, tables):
-      # seeds / n_valid: dicts keyed by seed type
-      states = {t: dense_init(tables[t][0], tables[t][1], budgets[t])
-                for t in self._node_counts}
-      seed_labels = {}
-      for t in seed_types:
-        mask = jnp.arange(batch_sizes[t]) < n_valid[t]
-        states[t], seed_labels[t] = dense_assign(states[t], seeds[t],
-                                                 mask)
-
-      frontier = {
-          t: (jax.lax.slice(states[t].nodes, (0,), (max(1, caps[0][t]),)),
-              jnp.arange(max(1, caps[0][t]), dtype=jnp.int32),
-              (jnp.arange(max(1, caps[0][t]), dtype=jnp.int32)
-               < states[t].count))
-          for t in self._node_counts}
-
-      rows_d: Dict[EdgeType, list] = {}
-      cols_d: Dict[EdgeType, list] = {}
-      mask_d: Dict[EdgeType, list] = {}
-      eid_d: Dict[EdgeType, list] = {}
-      hop_nodes = {t: [states[t].count] for t in self._node_counts}
-      hop_edges: Dict[EdgeType, list] = {}
-
-      for h in range(self.num_hops):
-        # sample every etype from the current frontier
-        per_type_nbrs = {t: [] for t in self._node_counts}
-        per_type_meta = []  # (etype, col_t, rows_parent, mask, eids, width)
-        for etype, (row_t, col_t) in trav.items():
-          k = self.num_neighbors[etype][h]
-          if caps[h][row_t] == 0 or k == 0:
-            continue
-          f_ids, f_labels, f_mask = frontier[row_t]
-          key, sub = jax.random.split(key)
-          out = self._one_hop(self.graph[etype], f_ids, k, sub, f_mask)
-          per_type_nbrs[col_t].append(
-              (out.nbrs.reshape(-1), out.mask.reshape(-1)))
-          per_type_meta.append(
-              (etype, col_t, jnp.repeat(f_labels, k),
-               out.mask.reshape(-1),
-               out.eids.reshape(-1) if self.with_edge else None,
-               caps[h][row_t] * k))
-        # merge each destination type once
-        prev_counts = {t: states[t].count for t in self._node_counts}
-        labels_by_type = {}
-        for t, chunks in per_type_nbrs.items():
-          if not chunks:
-            continue
-          ids = jnp.concatenate([c[0] for c in chunks])
-          ok = jnp.concatenate([c[1] for c in chunks])
-          states[t], labels = dense_assign(states[t], ids, ok)
-          labels_by_type[t] = labels
-        # slice per-etype labels back out
-        cursor = {t: 0 for t in self._node_counts}
-        for etype, col_t, rows_parent, mask, eids, width in per_type_meta:
-          s = cursor[col_t]
-          cursor[col_t] += width
-          labels = jax.lax.slice(labels_by_type[col_t], (s,), (s + width,))
-          rows_d.setdefault(etype, []).append(rows_parent)
-          cols_d.setdefault(etype, []).append(labels)
-          mask_d.setdefault(etype, []).append(mask)
-          if self.with_edge:
-            eid_d.setdefault(etype, []).append(eids)
-          hop_edges.setdefault(etype, []).append(
-              mask.sum().astype(jnp.int32))
-        # advance frontiers
-        for t in self._node_counts:
-          cap_next = max(1, caps[h + 1][t])
-          labels = prev_counts[t] + jnp.arange(cap_next, dtype=jnp.int32)
-          fmask = labels < states[t].count
-          ids = jnp.take(states[t].nodes,
-                         jnp.minimum(labels, budgets[t]))
-          frontier[t] = (ids, labels, fmask)
-          hop_nodes[t].append(states[t].count - prev_counts[t])
-
-      out_tables = {}
-      for t in self._node_counts:
-        out_tables[t] = dense_reset(states[t])
-      result = dict(
-          node={t: jax.lax.slice(states[t].nodes, (0,), (budgets[t],))
-                for t in self._node_counts},
-          node_count={t: states[t].count for t in self._node_counts},
-          row={e: jnp.concatenate(v) for e, v in rows_d.items()},
-          col={e: jnp.concatenate(v) for e, v in cols_d.items()},
-          edge_mask={e: jnp.concatenate(v) for e, v in mask_d.items()},
-          batch={t: jax.lax.slice(states[t].nodes, (0,),
-                                  (batch_sizes[t],))
-                 for t in seed_types},
-          seed_labels=seed_labels,
-          num_sampled_nodes={t: jnp.stack(v) for t, v in hop_nodes.items()},
-          num_sampled_edges={e: jnp.stack(v) for e, v in hop_edges.items()},
-      )
-      if self.with_edge:
-        result['edge'] = {e: jnp.concatenate(v) for e, v in eid_d.items()}
-      return result, out_tables
+      return multihop_sample_hetero(
+          one_hops, trav, self.num_neighbors, self.num_hops, caps,
+          budgets, seeds, n_valid, key, tables,
+          with_edge=self.with_edge)
 
     return jax.jit(fn, donate_argnums=(3,))
 

@@ -1,0 +1,61 @@
+"""MLPerf-style structured logging hooks.
+
+Reference: examples/igbh/mlperf_logging_utils.py (GLT was an MLPerf GNN
+submission vehicle). A dependency-free shim emitting the ':::MLLOG'
+line format so result parsers work; swaps transparently for the official
+mlperf_logging package when installed.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, Optional
+
+INTERVAL_START = 'INTERVAL_START'
+INTERVAL_END = 'INTERVAL_END'
+POINT_IN_TIME = 'POINT_IN_TIME'
+
+
+class MLLogger:
+  def __init__(self, benchmark: str = 'gnn', org: str = 'glt_tpu',
+               emit=print):
+    self.benchmark = benchmark
+    self.org = org
+    self._emit = emit
+
+  def _log(self, event_type: str, key: str, value: Any = None,
+           metadata: Optional[Dict] = None) -> None:
+    record = {
+        'namespace': self.benchmark,
+        'time_ms': int(time.time() * 1000),
+        'event_type': event_type,
+        'key': key,
+        'value': value,
+        'metadata': metadata or {},
+    }
+    self._emit(f':::MLLOG {json.dumps(record)}')
+
+  def start(self, key: str, value: Any = None, metadata=None):
+    self._log(INTERVAL_START, key, value, metadata)
+
+  def end(self, key: str, value: Any = None, metadata=None):
+    self._log(INTERVAL_END, key, value, metadata)
+
+  def event(self, key: str, value: Any = None, metadata=None):
+    self._log(POINT_IN_TIME, key, value, metadata)
+
+  # convenience markers used by the IGBH-style loop
+  def run_start(self):
+    self.start('run_start')
+
+  def run_stop(self, status: str = 'success'):
+    self.end('run_stop', metadata={'status': status})
+
+  def epoch_start(self, epoch: int):
+    self.start('epoch_start', metadata={'epoch_num': epoch})
+
+  def epoch_stop(self, epoch: int):
+    self.end('epoch_stop', metadata={'epoch_num': epoch})
+
+  def eval_accuracy(self, value: float, epoch: int):
+    self.event('eval_accuracy', value, metadata={'epoch_num': epoch})

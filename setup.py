@@ -1,0 +1,20 @@
+"""Install glt_tpu (pure Python; the native shm library builds on demand
+via make -C glt_tpu/csrc)."""
+from setuptools import find_packages, setup
+
+setup(
+    name='glt_tpu',
+    version='0.1.0',
+    description=('TPU-native graph learning framework: sampling, unified '
+                 'feature store, distributed GNN training on JAX/XLA'),
+    packages=find_packages(include=['glt_tpu', 'glt_tpu.*']),
+    package_data={'glt_tpu': ['csrc/*.cc', 'csrc/Makefile']},
+    python_requires='>=3.10',
+    install_requires=[
+        'jax', 'flax', 'optax', 'numpy',
+    ],
+    extras_require={
+        'ckpt': ['orbax-checkpoint'],
+        'test': ['pytest'],
+    },
+)

@@ -157,3 +157,31 @@ def test_table_dataset_node_reader():
   np.testing.assert_allclose(ds.get_node_feature()[np.arange(3)][:, 0],
                              [1., 2., 3.])
   np.testing.assert_array_equal(ds.get_node_label(), [7, 8, 9])
+
+
+def test_checkpoint_roundtrip(tmp_path):
+  import jax.numpy as jnp
+  from glt_tpu.utils.checkpoint import restore_checkpoint, save_checkpoint
+  params = {'w': jnp.arange(6.0).reshape(2, 3), 'b': jnp.zeros(3)}
+  save_checkpoint(str(tmp_path / 'ckpt'), step=5, params=params,
+                  extra={'epoch': 2})
+  step, payload = restore_checkpoint(str(tmp_path / 'ckpt'))
+  assert step == 5
+  np.testing.assert_allclose(np.asarray(payload['params']['w']),
+                             np.arange(6.0).reshape(2, 3))
+  assert payload['extra']['epoch'] == 2
+
+
+def test_mllog_format(capsys):
+  from glt_tpu.utils.mlperf_logging import MLLogger
+  lines = []
+  log = MLLogger(emit=lines.append)
+  log.run_start()
+  log.eval_accuracy(0.78, epoch=1)
+  log.run_stop()
+  assert len(lines) == 3
+  import json as _json
+  for l in lines:
+    assert l.startswith(':::MLLOG ')
+    rec = _json.loads(l[len(':::MLLOG '):])
+    assert 'key' in rec and 'time_ms' in rec
