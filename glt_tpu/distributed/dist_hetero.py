@@ -385,11 +385,12 @@ class DistHeteroTrainStep:
             if e in active}
     x_dict = {t: jnp.zeros((budgets[t], self.features[t].feature_dim))
               for t in self.features}
+    from ..ops.pipeline import hetero_edge_capacities
+    ecaps = hetero_edge_capacities(caps, trav, self.sampler.num_neighbors,
+                                   self.sampler.num_hops)
     row_d, col_d, mask_d = {}, {}, {}
-    for e, (row_t, col_t) in trav.items():
-      ecap = sum(max(caps[h][row_t], 0) * self.sampler.num_neighbors[e][h]
-                 for h in range(self.sampler.num_hops))
-      ecap = max(ecap, 1)
+    for e in trav:
+      ecap = max(ecaps[e], 1)
       k = self._final_key(e)
       row_d[k] = jnp.zeros((ecap,), jnp.int32)
       col_d[k] = jnp.zeros((ecap,), jnp.int32)

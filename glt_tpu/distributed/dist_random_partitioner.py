@@ -68,7 +68,7 @@ class DistRandomPartitioner:
                node_ids=None, node_feat=None,
                master_addr: str = '127.0.0.1', master_port: int = 30500,
                chunk_size: int = CHUNK, seed: int = 0,
-               bind_addr: str = '0.0.0.0',
+               bind_addr: Optional[str] = None,
                peer_addrs: Optional[List[str]] = None):
     self.output_dir = output_dir
     self.rank = int(rank)
@@ -81,10 +81,11 @@ class DistRandomPartitioner:
     self.chunk_size = int(chunk_size)
     self.seed = seed
     self.buffer = _PartitionBuffer()
-    # bind locally (0.0.0.0 works on any host); peers are reached at
-    # their own addresses — multi-host needs peer_addrs, single host
-    # defaults every peer to master_addr
-    self.server = RpcServer(bind_addr, master_port + rank)
+    # default stays loopback-safe (master_addr, typically 127.0.0.1);
+    # multi-host deployments pass bind_addr='0.0.0.0' (or the local
+    # interface) plus peer_addrs for the other ranks' hosts
+    self.server = RpcServer(bind_addr or master_addr,
+                            master_port + rank)
     self.server.register('push_edges', self.buffer.push_edges)
     self.server.register('push_node_feat', self.buffer.push_node_feat)
     self.peer_addrs = peer_addrs or [master_addr] * world_size

@@ -29,6 +29,7 @@ class NeighborLoader(NodeLoader):
                replace: bool = False,
                seed: Optional[int] = None,
                device=None,
+               prefetch_depth: int = 0,
                rng: Optional[np.random.Generator] = None):
     sampler = NeighborSampler(
         data.graph, num_neighbors,
@@ -37,4 +38,4 @@ class NeighborLoader(NodeLoader):
     super().__init__(data, sampler, input_nodes,
                      batch_size=batch_size, shuffle=shuffle,
                      drop_last=drop_last, collect_features=collect_features,
-                     rng=rng)
+                     prefetch_depth=prefetch_depth, rng=rng)

@@ -43,6 +43,7 @@ class NodeLoader:
                shuffle: bool = False,
                drop_last: bool = False,
                collect_features: bool = True,
+               prefetch_depth: int = 0,
                rng: Optional[np.random.Generator] = None):
     self.data = data
     self.sampler = sampler
@@ -55,6 +56,10 @@ class NodeLoader:
     self.shuffle = shuffle
     self.drop_last = drop_last
     self.collect_features = collect_features
+    #: >0 overlaps host batch prep (incl. cold-row gathers) with device
+    #: compute via a prefetch thread — the in-process analogue of the
+    #: reference's producer/channel overlap
+    self.prefetch_depth = int(prefetch_depth)
     self.rng = rng or np.random.default_rng(0)
     self._gather_cache = {}
 
@@ -65,6 +70,12 @@ class NodeLoader:
     return (n + self.batch_size - 1) // self.batch_size
 
   def __iter__(self) -> Iterator[Union[Batch, HeteroBatch]]:
+    if self.prefetch_depth > 0:
+      from ..utils.prefetch import prefetch
+      return iter(prefetch(self._epoch_iter(), self.prefetch_depth))
+    return self._epoch_iter()
+
+  def _epoch_iter(self) -> Iterator[Union[Batch, HeteroBatch]]:
     order = (self.rng.permutation(self.seeds.shape[0])
              if self.shuffle else np.arange(self.seeds.shape[0]))
     n = order.shape[0]

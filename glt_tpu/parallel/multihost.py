@@ -6,8 +6,10 @@ jax.distributed: one process per host, devices fused into one global
 mesh, ICI within a slice and DCN across slices handled by XLA. These
 helpers cover the two framework needs:
 
-  * initialize() — process-group bootstrap (MASTER_ADDR-style envs or
-    explicit coordinator), safe to call once per process.
+  * initialize() — process-group bootstrap. With explicit args it calls
+    jax.distributed.initialize directly; with no args it auto-initializes
+    when a cluster environment is detectable and otherwise no-ops
+    loudly-documented (single-process dev boxes).
   * global_from_local(mesh, local, axis) — assemble a mesh-sharded
     global array where THIS process contributes only its local block
     (jax.make_array_from_process_local_data), so a DistGraph/DistFeature
@@ -17,32 +19,45 @@ helpers cover the two framework needs:
 """
 from __future__ import annotations
 
+import logging
 import os
-from typing import Optional, Sequence
+from typing import Optional
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+logger = logging.getLogger(__name__)
+
+_CLUSTER_ENVS = (
+    'JAX_COORDINATOR_ADDRESS', 'COORDINATOR_ADDRESS',
+    'MEGASCALE_COORDINATOR_ADDRESS', 'TPU_WORKER_HOSTNAMES',
+)
+
 
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None) -> None:
-  """Bootstrap jax.distributed (no-op for a single process)."""
-  if num_processes in (None, 1) and coordinator_address is None:
+  """Bootstrap jax.distributed.
+
+  Explicit args are forwarded directly. With no args, the cluster is
+  auto-detected: when a known coordinator env is present (or jax's own
+  cluster detection succeeds) jax.distributed.initialize() runs with
+  auto-detection; on a plain single-process machine this is a no-op and
+  says so at debug level — it never silently skips a *detectable*
+  cluster.
+  """
+  if (coordinator_address is not None or num_processes is not None
+      or process_id is not None):
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id)
     return
-  jax.distributed.initialize(
-      coordinator_address=coordinator_address,
-      num_processes=num_processes, process_id=process_id)
-
-
-def process_mesh_info(mesh: Mesh, axis: str = 'data'):
-  """(num_shards, shards_owned_by_this_process) along ``axis``."""
-  n = mesh.shape[axis]
-  devices = mesh.devices.reshape(-1)
-  mine = [i for i, d in enumerate(devices)
-          if d.process_index == jax.process_index()]
-  return n, mine
+  if any(os.environ.get(k) for k in _CLUSTER_ENVS):
+    jax.distributed.initialize()
+    return
+  logger.debug('multihost.initialize: no cluster environment detected; '
+               'running single-process')
 
 
 def global_from_local(mesh: Mesh, local: np.ndarray, axis: str = 'data'):

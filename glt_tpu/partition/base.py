@@ -41,6 +41,22 @@ def _etype_dir(etype: EdgeType) -> str:
   return as_str(etype)
 
 
+
+def _write_node_feat(root_dir: str, part: int, ntype, feats, ids,
+                     cache_feats=None, cache_ids=None) -> None:
+  """Single place that owns the node_feat on-disk payload/path contract
+  (used by the offline partitioner and stage-2 feature builds)."""
+  payload = dict(feats=feats, ids=ids)
+  if cache_feats is not None and cache_ids is not None \
+      and len(cache_ids):
+    payload['cache_feats'] = cache_feats
+    payload['cache_ids'] = cache_ids
+  d = os.path.join(root_dir, f'part{part}', 'node_feat')
+  os.makedirs(d, exist_ok=True)
+  np.savez(os.path.join(d, f'{ntype}.npz') if ntype
+           else os.path.join(d, 'data.npz'), **payload)
+
+
 class PartitionerBase:
   """Chunked offline partitioner (abstract `_partition_node`).
 
@@ -183,14 +199,12 @@ class PartitionerBase:
     cache = self._cache_node(ntype)
     for p in range(self.num_parts):
       ids = np.nonzero(node_pb == p)[0]
-      payload = dict(feats=feat[ids], ids=ids)
-      if cache is not None and cache[p].size:
-        payload['cache_feats'] = feat[cache[p]]
-        payload['cache_ids'] = cache[p]
-      d = os.path.join(self.output_dir, f'part{p}', 'node_feat')
-      os.makedirs(d, exist_ok=True)
-      np.savez(os.path.join(d, f'{ntype}.npz') if ntype
-               else os.path.join(d, 'data.npz'), **payload)
+      _write_node_feat(
+          self.output_dir, p, ntype, feat[ids], ids,
+          cache_feats=(feat[cache[p]] if cache is not None
+                       and cache[p].size else None),
+          cache_ids=(cache[p] if cache is not None and cache[p].size
+                     else None))
 
 
 # -- loading -----------------------------------------------------------------
@@ -300,16 +314,13 @@ def build_partition_feature(root_dir: str, node_feat, ntype=None,
   cache_num = int(pb.shape[0] * cache_ratio) if cache_ratio else 0
   for p in range(meta['num_parts']):
     ids = np.nonzero(pb == p)[0]
-    payload = dict(feats=node_feat[ids], ids=ids)
+    cache_feats = cache_ids = None
     if cache_num and probs is not None:
       score = probs.copy()
       score[ids] = -1.0
       hot = np.argsort(-score)[:cache_num]
       hot = hot[score[hot] > 0]
       if hot.size:
-        payload['cache_feats'] = node_feat[hot]
-        payload['cache_ids'] = hot
-    d = os.path.join(root_dir, f'part{p}', 'node_feat')
-    os.makedirs(d, exist_ok=True)
-    np.savez(os.path.join(d, f'{ntype}.npz') if ntype
-             else os.path.join(d, 'data.npz'), **payload)
+        cache_feats, cache_ids = node_feat[hot], hot
+    _write_node_feat(root_dir, p, ntype, node_feat[ids], ids,
+                     cache_feats=cache_feats, cache_ids=cache_ids)

@@ -1,0 +1,67 @@
+"""Host-side prefetching iterator.
+
+The reference hides sampling/feature latency behind training with
+multi-process producers and shm channels (dist_sampling_producer.py). For
+the in-process loaders the same overlap comes from a small prefetch
+thread: while the device executes step N, the host prepares batch N+1
+(seed shuffling, cold-row gathers, device_put). jit dispatch being async,
+depth 2 is usually enough to keep the chip busy.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator
+
+
+class PrefetchIterator:
+  """Wraps any batch iterable; materializes up to ``depth`` batches ahead
+  on a daemon thread. Exceptions propagate to the consumer."""
+
+  _END = object()
+
+  def __init__(self, iterable: Iterable, depth: int = 2):
+    self.iterable = iterable
+    self.depth = max(1, int(depth))
+
+  def __iter__(self) -> Iterator:
+    q: 'queue.Queue' = queue.Queue(maxsize=self.depth)
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+      # bounded puts poll the stop flag so an abandoned consumer can't
+      # leave the worker blocked forever holding batch references
+      while not stop.is_set():
+        try:
+          q.put(item, timeout=0.1)
+          return True
+        except queue.Full:
+          continue
+      return False
+
+    def worker():
+      try:
+        for item in self.iterable:
+          if not _put(item):
+            return
+      except BaseException as e:  # surface to consumer
+        _put(e)
+        return
+      _put(self._END)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+      while True:
+        item = q.get()
+        if item is self._END:
+          return
+        if isinstance(item, BaseException):
+          raise item
+        yield item
+    finally:
+      stop.set()
+
+
+def prefetch(iterable: Iterable, depth: int = 2) -> PrefetchIterator:
+  return PrefetchIterator(iterable, depth)

@@ -152,3 +152,29 @@ def test_hetero_loader(ring=None):
   assert ('item', 'rev_u2i', 'user') in b.row_dict
   np.testing.assert_array_equal(np.asarray(b.y_dict['user']),
                                 np.arange(4) % 3)
+
+
+def test_prefetching_loader_matches_sync(ring):
+  sync = NeighborLoader(ring, [2], input_nodes=np.arange(40),
+                        batch_size=8, shuffle=False, seed=0)
+  pre = NeighborLoader(ring, [2], input_nodes=np.arange(40),
+                       batch_size=8, shuffle=False, seed=0,
+                       prefetch_depth=2)
+  a = list(sync)
+  b = list(pre)
+  assert len(a) == len(b) == 5
+  for x, y in zip(a, b):
+    np.testing.assert_array_equal(np.asarray(x.batch), np.asarray(y.batch))
+    assert int(x.node_count) == int(y.node_count)
+
+
+def test_prefetch_iterator_propagates_errors():
+  from glt_tpu.utils.prefetch import prefetch
+  def gen():
+    yield 1
+    raise ValueError('boom')
+  it = iter(prefetch(gen(), depth=2))
+  assert next(it) == 1
+  import pytest as _pytest
+  with _pytest.raises(ValueError):
+    next(it)

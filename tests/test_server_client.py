@@ -169,10 +169,12 @@ def test_dist_random_partitioner_two_ranks(tmp_path):
   for t in threads:
     t.start()
   for t in threads:
-    t.join(timeout=60)
+    t.join(timeout=180)
+  alive = [t for t in threads if t.is_alive()]
   for p in parts:
     p.shutdown()
   assert not errs, errs
+  assert not alive, 'partitioner ranks did not finish'
 
   node_pb = np.load(str(tmp_path / 'node_pb.npy'))
   seen_eids, seen_nodes = [], []
@@ -219,9 +221,11 @@ def test_dist_partitioner_output_loads(tmp_path):
   threads = [threading.Thread(target=run_rank, args=(r,))
              for r in range(2)]
   for t in threads: t.start()
-  for t in threads: t.join(timeout=60)
+  for t in threads: t.join(timeout=180)
+  alive = [t for t in threads if t.is_alive()]
   for p in parts: p.shutdown()
   assert not errs, errs
+  assert not alive, 'partitioner ranks did not finish'
 
   ds = DistDataset().load(str(tmp_path), 0)
   assert ds.num_partitions == 2
@@ -259,8 +263,10 @@ def test_dist_table_dataset(tmp_path):
   threads = [threading.Thread(target=run_rank, args=(r,))
              for r in range(2)]
   for t in threads: t.start()
-  for t in threads: t.join(timeout=60)
+  for t in threads: t.join(timeout=180)
+  alive = [t for t in threads if t.is_alive()]
   assert not errs, errs
+  assert not alive, 'partitioner ranks did not finish'
   node_pb = np.load(str(tmp_path / 'node_pb.npy'))
   for r in range(2):
     ds = out[r]
