@@ -124,8 +124,13 @@ class Feature:
 
   def device_gather(self, rows: jax.Array) -> jax.Array:
     """Jit-safe gather; only valid when fully device resident (hot==all).
-    ``rows`` are post-id2index row indices."""
+    ``rows`` are post-id2index row indices. With GLT_USE_PALLAS=1 on a
+    TPU backend the Pallas row-gather kernel serves this path."""
     self.lazy_init()
+    from ..ops.pallas_kernels import gather_rows, use_pallas_default
+    if use_pallas_default():
+      return gather_rows(self._hot, rows.reshape(-1)).reshape(
+          rows.shape + (self._hot.shape[1],))
     return jnp.take(self._hot, rows, axis=0, mode='clip')
 
   def gather_cold_host(self, rows: np.ndarray) -> np.ndarray:

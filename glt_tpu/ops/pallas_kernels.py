@@ -1,0 +1,70 @@
+"""Pallas TPU kernels for the hot paths.
+
+The XLA-native formulations in ops/ are the correctness baseline; these
+kernels are drop-in accelerations, opt-in via ``GLT_USE_PALLAS=1`` until
+profiled on hardware (the development environment's TPU tunnel was down
+when they were written — interpret-mode parity tests gate correctness,
+the flag gates deployment).
+
+``gather_rows``: the feature-store row gather (UnifiedTensor's
+GatherTensorKernel analogue, unified_tensor.cu:35-81). Uses the canonical
+TPU embedding-gather pattern: row indices are scalar-prefetched so the
+BlockSpec index_map can steer one row-block DMA per grid step, and the
+Pallas pipeline double-buffers those HBM->VMEM copies behind the writes.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+
+def pallas_available() -> bool:
+  try:
+    from jax.experimental import pallas as pl  # noqa: F401
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+    return True
+  except ImportError:
+    return False
+
+
+def use_pallas_default() -> bool:
+  if os.environ.get('GLT_USE_PALLAS', '') not in ('1', 'true', 'True'):
+    return False
+  return (pallas_available()
+          and jax.default_backend() == 'tpu')
+
+
+@functools.partial(jax.jit, static_argnames=('interpret',))
+def gather_rows(table: jax.Array, rows: jax.Array,
+                interpret: bool = False) -> jax.Array:
+  """table: [N, D]; rows: [B] int32 -> [B, D].
+
+  Out-of-range rows are clamped (mode='clip' semantics of the XLA path).
+  """
+  from jax.experimental import pallas as pl
+  from jax.experimental.pallas import tpu as pltpu
+
+  n, d = table.shape
+  b = rows.shape[0]
+  rows = jnp.clip(rows.astype(jnp.int32), 0, n - 1)
+
+  def kernel(idx_ref, row_ref, out_ref):
+    out_ref[:] = row_ref[:]
+
+  grid_spec = pltpu.PrefetchScalarGridSpec(
+      num_scalar_prefetch=1,
+      grid=(b,),
+      in_specs=[
+          pl.BlockSpec((1, d), lambda i, idx: (idx[i], 0)),
+      ],
+      out_specs=pl.BlockSpec((1, d), lambda i, idx: (i, 0)),
+  )
+  return pl.pallas_call(
+      kernel,
+      grid_spec=grid_spec,
+      out_shape=jax.ShapeDtypeStruct((b, d), table.dtype),
+      interpret=interpret,
+  )(rows, table)
