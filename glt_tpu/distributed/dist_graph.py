@@ -68,9 +68,10 @@ class DistGraph:
     assert mesh.shape[axis] == n_parts, (
         f'mesh axis size {mesh.shape[axis]} != partitions {n_parts}')
 
-    indptrs, indices_l, eids_l, locals_l = [], [], [], []
+    indptrs, indices_l, eids_l, locals_l, weights_l = [], [], [], [], []
     max_rows, max_edges = 1, 1
     built = []
+    has_weights = all(p.weights is not None for p in parts)
     for p, g in enumerate(parts):
       src, dst = as_numpy(g.edge_index)
       row, col = (src, dst) if edge_dir == 'out' else (dst, src)
@@ -79,12 +80,15 @@ class DistGraph:
       local_of[owned] = np.arange(owned.shape[0], dtype=np.int32)
       topo = Topology(
           edge_index=np.stack([local_of[row], col]),
-          edge_ids=as_numpy(g.eids), layout='CSR',
+          edge_ids=as_numpy(g.eids),
+          edge_weights=as_numpy(g.weights) if has_weights else None,
+          layout='CSR',
           num_rows=owned.shape[0], num_cols=self.num_nodes)
       built.append((topo, local_of))
       max_rows = max(max_rows, owned.shape[0])
       max_edges = max(max_edges, topo.num_edges)
 
+    max_degree = 1
     for topo, local_of in built:
       ip = topo.indptr.astype(np.int32)
       ip = np.concatenate(
@@ -99,18 +103,26 @@ class DistGraph:
       indices_l.append(ind)
       eids_l.append(eid)
       locals_l.append(local_of)
+      if has_weights:
+        weights_l.append(np.concatenate(
+            [topo.edge_weights.astype(np.float32),
+             np.zeros(max_edges - topo.num_edges, np.float32)]))
+      max_degree = max(max_degree, topo.max_degree)
 
     shard = NamedSharding(mesh, P(axis))
     repl = NamedSharding(mesh, P())
     self.indptr = jax.device_put(np.stack(indptrs), shard)    # [P, R+1]
     self.indices = jax.device_put(np.stack(indices_l), shard)  # [P, E]
     self.edge_ids = jax.device_put(np.stack(eids_l), shard)
+    self.edge_weights = (jax.device_put(np.stack(weights_l), shard)
+                         if has_weights else None)
     self.local_row = jax.device_put(np.stack(locals_l), shard)  # [P, N]
     self.node_pb = jax.device_put(
         _pb_dense(node_pb, self.num_nodes), repl)               # [N]
     self.num_partitions = n_parts
     self.max_rows = max_rows
     self.max_edges = max_edges
+    self.max_degree = max_degree
 
   @classmethod
   def from_dataset_partitions(cls, mesh: Mesh, root_dir: str,

@@ -37,17 +37,21 @@ from .dist_graph import DistGraph
 
 
 def make_dist_one_hop(graph_shards: Dict[str, jax.Array], num_nodes: int,
-                      n_parts: int, rows_max: int, axis: str):
+                      n_parts: int, rows_max: int, axis: str,
+                      with_weight: bool = False,
+                      max_weighted_degree: int = 0):
   """Build the in-shard one-hop closure over sharded CSR blocks.
 
   graph_shards: dict with this device's 'indptr' [R+1], 'indices' [E],
-  'edge_ids' [E], 'local_row' [N] and replicated 'node_pb' [N].
+  'edge_ids' [E], 'local_row' [N], replicated 'node_pb' [N] and (for the
+  weighted path) 'edge_weights' [E].
   """
   indptr = graph_shards['indptr']
   indices = graph_shards['indices']
   eids = graph_shards['edge_ids']
   local_row = graph_shards['local_row']
   node_pb = graph_shards['node_pb']
+  weights = graph_shards.get('edge_weights')
 
   def one_hop(ids, fanout, key, mask):
     f = ids.shape[0]
@@ -63,9 +67,17 @@ def make_dist_one_hop(graph_shards: Dict[str, jax.Array], num_nodes: int,
     # every device serves with the same folded key stream: fold by the
     # serving device so remote requests get independent randomness
     serve_key = jax.random.fold_in(key, jax.lax.axis_index(axis))
-    out = sample_neighbors(indptr, indices,
-                           jnp.clip(lrow, 0, rows_max - 1), fanout,
-                           serve_key, seed_mask=ok, edge_ids=eids)
+    if with_weight and weights is not None:
+      from ..ops.sample import sample_neighbors_weighted
+      out = sample_neighbors_weighted(
+          indptr, indices, weights, jnp.clip(lrow, 0, rows_max - 1),
+          fanout, serve_key,
+          max_degree=max(max_weighted_degree, fanout),
+          seed_mask=ok, edge_ids=eids)
+    else:
+      out = sample_neighbors(indptr, indices,
+                             jnp.clip(lrow, 0, rows_max - 1), fanout,
+                             serve_key, seed_mask=ok, edge_ids=eids)
     resp_nbrs = all_to_all(out.nbrs.reshape(n_parts, f, fanout), axis)
     resp_mask = all_to_all(out.mask.reshape(n_parts, f, fanout), axis)
     resp_eids = all_to_all(out.eids.reshape(n_parts, f, fanout), axis)
@@ -87,10 +99,15 @@ class DistNeighborSampler:
   """
 
   def __init__(self, dist_graph: DistGraph, num_neighbors: Sequence[int],
-               with_edge: bool = False, seed: Optional[int] = None):
+               with_edge: bool = False, with_weight: bool = False,
+               max_weighted_degree: Optional[int] = None,
+               seed: Optional[int] = None):
     self.g = dist_graph
     self.num_neighbors = list(num_neighbors)
     self.with_edge = with_edge
+    self.with_weight = with_weight and dist_graph.edge_weights is not None
+    self.max_weighted_degree = (max_weighted_degree
+                                or getattr(dist_graph, 'max_degree', 1))
     self.mesh = dist_graph.mesh
     self.axis = dist_graph.axis
     self._base_key = jax.random.key(
@@ -117,13 +134,17 @@ class DistNeighborSampler:
     fanouts = self.num_neighbors
     with_edge = self.with_edge
 
-    def device_fn(indptr, indices, eids, local_row, node_pb, seeds,
-                  n_valid, key, table, scratch):
+    def device_fn(indptr, indices, eids, weights, local_row, node_pb,
+                  seeds, n_valid, key, table, scratch):
       shards = dict(indptr=indptr[0], indices=indices[0],
                     edge_ids=eids[0], local_row=local_row[0],
                     node_pb=node_pb)
-      one_hop = make_dist_one_hop(shards, g.num_nodes, n_parts,
-                                  g.max_rows, axis)
+      if weights is not None:
+        shards['edge_weights'] = weights[0]
+      one_hop = make_dist_one_hop(
+          shards, g.num_nodes, n_parts, g.max_rows, axis,
+          with_weight=self.with_weight,
+          max_weighted_degree=self.max_weighted_degree)
       my_key = jax.random.fold_in(key[0], jax.lax.axis_index(axis))
       out, table_o, scratch_o = multihop_sample(
           one_hop, seeds, n_valid[0], fanouts, my_key, table[0],
@@ -132,17 +153,19 @@ class DistNeighborSampler:
       return out, table_o[None], scratch_o[None]
 
     sp = P(self.axis)
+    w_spec = sp if g.edge_weights is not None else None
     fn = jax.shard_map(
         device_fn, mesh=self.mesh,
-        in_specs=(sp, sp, sp, sp, P(), sp, sp, sp, sp, sp),
+        in_specs=(sp, sp, sp, w_spec, sp, P(), sp, sp, sp, sp, sp),
         out_specs=({k: sp for k in self._out_keys()}, sp, sp),
         check_vma=False)
 
     import functools
     @functools.partial(jax.jit, donate_argnums=(3, 4))
     def step(seeds, n_valid, keys, tables, scratches):
-      return fn(g.indptr, g.indices, g.edge_ids, g.local_row, g.node_pb,
-                seeds, n_valid, keys, tables, scratches)
+      return fn(g.indptr, g.indices, g.edge_ids, g.edge_weights,
+                g.local_row, g.node_pb, seeds, n_valid, keys, tables,
+                scratches)
 
     return step
 

@@ -1,5 +1,6 @@
 from .transform import (
     Batch, HeteroBatch, to_batch, to_hetero_batch, to_torch_data,
+    to_pyg_v1,
 )
 from .node_loader import NodeLoader
 from .neighbor_loader import NeighborLoader
@@ -9,6 +10,7 @@ from .subgraph_loader import SubGraphLoader
 
 __all__ = [
     'Batch', 'HeteroBatch', 'to_batch', 'to_hetero_batch', 'to_torch_data',
+    'to_pyg_v1',
     'NodeLoader', 'NeighborLoader',
     'LinkLoader', 'LinkNeighborLoader', 'get_edge_label_index',
     'SubGraphLoader',

@@ -116,6 +116,35 @@ def to_hetero_batch(out: HeteroSamplerOutput,
   )
 
 
+def to_pyg_v1(batch: Batch):
+  """PyG-v1-style (batch_size, n_id, adjs) view (the reference's
+  ``as_pyg_v1`` NeighborLoader mode, loader/neighbor_loader.py:110).
+
+  adjs are returned outermost-hop-first (the order layer loops consume):
+  each is (edge_index [2, m] numpy in message-flow orientation, e_id or
+  None, size (src_count, dst_count)). Requires edge_hop_offsets.
+  """
+  import numpy as np
+  assert batch.edge_hop_offsets is not None
+  offs = batch.edge_hop_offsets
+  em = np.asarray(batch.edge_mask)
+  row = np.asarray(batch.row)
+  col = np.asarray(batch.col)
+  eid = np.asarray(batch.edge) if batch.edge is not None else None
+  counts = np.asarray(batch.num_sampled_nodes)
+  n_id = np.asarray(batch.node)[:int(batch.node_count)]
+  adjs = []
+  for h in range(len(offs) - 1):
+    sl = slice(offs[h], offs[h + 1])
+    keep = em[sl]
+    edge_index = np.stack([row[sl][keep], col[sl][keep]])
+    e_id = eid[sl][keep] if eid is not None else None
+    src_count = int(counts[:h + 2].sum())
+    dst_count = int(counts[:h + 1].sum())
+    adjs.append((edge_index, e_id, (src_count, dst_count)))
+  return batch.batch_size, n_id, list(reversed(adjs))
+
+
 def to_torch_data(batch: Batch):
   """Optional PyG interop (CPU): mirrors reference to_data field-for-field.
   Requires torch_geometric; raises ImportError otherwise."""

@@ -252,3 +252,33 @@ def test_dist_hetero_train_step(tmp_path_factory, mesh):
                              jax.random.key(it))
     losses.append(float(np.asarray(loss)[0]))
   assert losses[-1] < losses[0], f'no learning: {losses[::6]}'
+
+
+def test_dist_weighted_sampling(tmp_path_factory, mesh):
+  """Distributed weighted sampling: the dominant-weight edge is sampled
+  nearly always (reference parity: weighted sampling works through the
+  partitioned path)."""
+  root = str(tmp_path_factory.mktemp('wparts'))
+  rows, cols, eids = ring_edges(N_NODES)
+  w = np.ones(2 * N_NODES, np.float32)
+  w[eids % 2 == 0] = 1000.0   # the (v -> v+1) edge dominates
+  RandomPartitioner(root, num_parts=N_PARTS, num_nodes=N_NODES,
+                    edge_index=np.stack([rows, cols]),
+                    edge_weights=w).partition()
+  dg = DistGraph.from_dataset_partitions(mesh, root)
+  assert dg.edge_weights is not None
+  s = DistNeighborSampler(dg, [1], with_weight=True, seed=0)
+  hits = total = 0
+  for trial in range(12):
+    seeds = ((np.arange(N_PARTS) + trial * N_PARTS) % N_NODES)[:, None]
+    out = s.sample_from_nodes(seeds)
+    nodes = np.asarray(out['node'])
+    counts = np.asarray(out['node_count'])
+    for p in range(N_PARTS):
+      v = int(seeds[p, 0])
+      got = set(nodes[p][:counts[p]].tolist()) - {v}
+      if got:
+        total += 1
+        hits += int((v + 1) % N_NODES in got)
+  assert total > 50
+  assert hits / total > 0.95, f'{hits}/{total}'

@@ -178,3 +178,23 @@ def test_prefetch_iterator_propagates_errors():
   import pytest as _pytest
   with _pytest.raises(ValueError):
     next(it)
+
+
+def test_to_pyg_v1_adapter(ring):
+  from glt_tpu.loader import to_pyg_v1
+  loader = NeighborLoader(ring, [2, 2], input_nodes=np.arange(8),
+                          batch_size=8, seed=0)
+  b = next(iter(loader))
+  bs, n_id, adjs = to_pyg_v1(b)
+  assert bs == 8
+  assert len(adjs) == 2
+  # innermost adj last: its dst count equals the seed count
+  edge_index, e_id, (src_n, dst_n) = adjs[-1]
+  assert dst_n == 8
+  # all labels within n_id bounds; ring relation holds per hop
+  for edge_index, e_id, (src_n, dst_n) in adjs:
+    assert edge_index.max() < len(n_id)
+    child = n_id[edge_index[0]]
+    parent = n_id[edge_index[1]]
+    for p, c in zip(parent, child):
+      assert c in ((p + 1) % 40, (p + 2) % 40)
