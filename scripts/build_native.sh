@@ -1,0 +1,4 @@
+#!/usr/bin/env bash
+# Build the native shared-memory queue library (plain g++).
+set -e
+make -C "$(dirname "$0")/../glt_tpu/csrc"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Run the test suite on the 8-device virtual CPU mesh.
+set -e
+cd "$(dirname "$0")/.."
+python -m pytest tests/ -q "$@"
