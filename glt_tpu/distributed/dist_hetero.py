@@ -66,8 +66,9 @@ class DistHeteroGraph:
     from ..data import Topology
     from .dist_graph import _pb_dense
     n_parts = len(parts)
-    indptrs, indices_l, eids_l, locals_l = [], [], [], []
-    max_rows, max_edges = 1, 1
+    indptrs, indices_l, eids_l, locals_l, weights_l = [], [], [], [], []
+    max_rows, max_edges, max_degree = 1, 1, 1
+    has_weights = all(p.weights is not None for p in parts)
     built = []
     for g in parts:
       src, dst = as_numpy(g.edge_index)
@@ -76,12 +77,16 @@ class DistHeteroGraph:
       local_of = np.full(num_rows_global, -1, np.int32)
       local_of[owned] = np.arange(owned.shape[0], dtype=np.int32)
       topo = Topology(edge_index=np.stack([local_of[row], col]),
-                      edge_ids=as_numpy(g.eids), layout='CSR',
+                      edge_ids=as_numpy(g.eids),
+                      edge_weights=(as_numpy(g.weights) if has_weights
+                                    else None),
+                      layout='CSR',
                       num_rows=owned.shape[0],
                       num_cols=num_cols_global)
       built.append((topo, local_of))
       max_rows = max(max_rows, owned.shape[0])
       max_edges = max(max_edges, topo.num_edges)
+      max_degree = max(max_degree, topo.max_degree)
     for topo, local_of in built:
       ip = topo.indptr.astype(np.int32)
       ip = np.concatenate(
@@ -94,6 +99,10 @@ class DistHeteroGraph:
           [topo.edge_ids.astype(np.int64),
            np.full(max_edges - topo.num_edges, -1, np.int64)]))
       locals_l.append(local_of)
+      if has_weights:
+        weights_l.append(np.concatenate(
+            [topo.edge_weights.astype(np.float32),
+             np.zeros(max_edges - topo.num_edges, np.float32)]))
     shard = NamedSharding(mesh, P(axis))
     repl = NamedSharding(mesh, P())
     store.mesh = mesh
@@ -103,12 +112,15 @@ class DistHeteroGraph:
     store.indptr = jax.device_put(np.stack(indptrs), shard)
     store.indices = jax.device_put(np.stack(indices_l), shard)
     store.edge_ids = jax.device_put(np.stack(eids_l), shard)
+    store.edge_weights = (jax.device_put(np.stack(weights_l), shard)
+                          if has_weights else None)
     store.local_row = jax.device_put(np.stack(locals_l), shard)
     store.node_pb = jax.device_put(_pb_dense(node_pb, num_rows_global),
                                    repl)
     store.num_partitions = n_parts
     store.max_rows = max_rows
     store.max_edges = max_edges
+    store.max_degree = max_degree
 
   @classmethod
   def from_dataset_partitions(cls, mesh: Mesh, root_dir: str,
@@ -152,11 +164,16 @@ class DistHeteroNeighborSampler:
   """SPMD hetero sampling: per-device seed batches of one seed type."""
 
   def __init__(self, graph: DistHeteroGraph, num_neighbors,
-               with_edge: bool = False, seed: Optional[int] = None):
+               with_edge: bool = False, with_weight: bool = False,
+               max_weighted_degree: Optional[int] = None,
+               seed: Optional[int] = None):
     self.g = graph
     self.mesh = graph.mesh
     self.axis = graph.axis
     self.with_edge = with_edge
+    self.with_weight = with_weight and all(
+        s.edge_weights is not None for s in graph.graphs.values())
+    self.max_weighted_degree = max_weighted_degree
     self.edge_types = list(graph.graphs.keys())
     if isinstance(num_neighbors, dict):
       self.num_neighbors = {k: list(v) for k, v in num_neighbors.items()}
@@ -229,12 +246,18 @@ class DistHeteroNeighborSampler:
       one_hops = {}
       for e in etypes:
         sh = shards[e]
+        gs = dict(indptr=sh['indptr'], indices=sh['indices'],
+                  edge_ids=sh['edge_ids'],
+                  local_row=sh['local_row'],
+                  node_pb=sh['node_pb'])
+        if 'edge_weights' in sh:
+          gs['edge_weights'] = sh['edge_weights']
         one_hops[e] = make_dist_one_hop(
-            dict(indptr=sh['indptr'], indices=sh['indices'],
-                 edge_ids=sh['edge_ids'],
-                 local_row=sh['local_row'],
-                 node_pb=sh['node_pb']),
-            g.graphs[e].num_nodes, n_parts, g.graphs[e].max_rows, axis)
+            gs, g.graphs[e].num_nodes, n_parts, g.graphs[e].max_rows,
+            axis, with_weight=self.with_weight,
+            max_weighted_degree=(self.max_weighted_degree
+                                 or getattr(g.graphs[e], 'max_degree',
+                                            1)))
 
       trav_active = {e: trav[e] for e in etypes}
       result, out_tables = multihop_sample_hetero(
@@ -257,12 +280,14 @@ class DistHeteroNeighborSampler:
         batch_size, seed_type)
 
     def device_fn(shards, seeds, n_valid, key, tables):
-      shards_in = {e: dict(indptr=sh['indptr'][0],
-                           indices=sh['indices'][0],
-                           edge_ids=sh['edge_ids'][0],
-                           local_row=sh['local_row'][0],
-                           node_pb=sh['node_pb'])
-                   for e, sh in shards.items()}
+      def unpack(sh):
+        d = dict(indptr=sh['indptr'][0], indices=sh['indices'][0],
+                 edge_ids=sh['edge_ids'][0],
+                 local_row=sh['local_row'][0], node_pb=sh['node_pb'])
+        if 'edge_weights' in sh:
+          d['edge_weights'] = sh['edge_weights'][0]
+        return d
+      shards_in = {e: unpack(sh) for e, sh in shards.items()}
       key = jax.random.fold_in(key[0], jax.lax.axis_index(self.axis))
       flat_tables = {t: (tables[t][0][0], tables[t][1][0])
                      for t in tables}
@@ -274,9 +299,13 @@ class DistHeteroNeighborSampler:
       return result, out_tables
 
     sp = P(self.axis)
-    shard_specs = {e: dict(indptr=sp, indices=sp, edge_ids=sp,
-                           local_row=sp, node_pb=P())
-                   for e in etypes}
+    def etype_spec(e):
+      d = dict(indptr=sp, indices=sp, edge_ids=sp, local_row=sp,
+               node_pb=P())
+      if g.graphs[e].edge_weights is not None:
+        d['edge_weights'] = sp
+      return d
+    shard_specs = {e: etype_spec(e) for e in etypes}
     out_elem = {
         'node': {t: sp for t in types},
         'node_count': {t: sp for t in types},
@@ -297,11 +326,15 @@ class DistHeteroNeighborSampler:
 
     @functools.partial(jax.jit, donate_argnums=(3,))
     def step(seeds, n_valid, keys, tables):
-      shards = {e: dict(indptr=g.graphs[e].indptr,
-                        indices=g.graphs[e].indices,
-                        edge_ids=g.graphs[e].edge_ids,
-                        local_row=g.graphs[e].local_row,
-                        node_pb=g.graphs[e].node_pb) for e in etypes}
+      def etype_payload(e):
+        d = dict(indptr=g.graphs[e].indptr, indices=g.graphs[e].indices,
+                 edge_ids=g.graphs[e].edge_ids,
+                 local_row=g.graphs[e].local_row,
+                 node_pb=g.graphs[e].node_pb)
+        if g.graphs[e].edge_weights is not None:
+          d['edge_weights'] = g.graphs[e].edge_weights
+        return d
+      shards = {e: etype_payload(e) for e in etypes}
       return fn(shards, seeds, n_valid, keys, tables)
 
     return step
@@ -422,12 +455,14 @@ class DistHeteroTrainStep:
 
     def device_step(params, opt_state, shards, feat_shards, labels,
                     seeds, n_valid, key, tables):
-      shards_in = {e: dict(indptr=sh['indptr'][0],
-                           indices=sh['indices'][0],
-                           edge_ids=sh['edge_ids'][0],
-                           local_row=sh['local_row'][0],
-                           node_pb=sh['node_pb'])
-                   for e, sh in shards.items()}
+      def unpack(sh):
+        d = dict(indptr=sh['indptr'][0], indices=sh['indices'][0],
+                 edge_ids=sh['edge_ids'][0],
+                 local_row=sh['local_row'][0], node_pb=sh['node_pb'])
+        if 'edge_weights' in sh:
+          d['edge_weights'] = sh['edge_weights'][0]
+        return d
+      shards_in = {e: unpack(sh) for e, sh in shards.items()}
       my_key = jax.random.fold_in(key[0], jax.lax.axis_index(axis))
       flat_tables = {t: (tables[t][0][0], tables[t][1][0])
                      for t in tables}
@@ -468,8 +503,13 @@ class DistHeteroTrainStep:
       return params, opt_state, out_tables, loss[None]
 
     sp = P(self.axis)
-    shard_specs = {e: dict(indptr=sp, indices=sp, edge_ids=sp,
-                           local_row=sp, node_pb=P()) for e in etypes}
+    def etype_spec2(e):
+      d = dict(indptr=sp, indices=sp, edge_ids=sp, local_row=sp,
+               node_pb=P())
+      if g.graphs[e].edge_weights is not None:
+        d['edge_weights'] = sp
+      return d
+    shard_specs = {e: etype_spec2(e) for e in etypes}
     feat_specs = {t: dict(array=sp, id2index=sp, feat_pb=sp)
                   for t in types}
     table_specs = {t: (sp, sp) for t in types}
@@ -484,11 +524,15 @@ class DistHeteroTrainStep:
     import functools
     @functools.partial(jax.jit, donate_argnums=(2,))
     def step(params, opt_state, tables, seeds, n_valid, keys):
-      shards = {e: dict(indptr=g.graphs[e].indptr,
-                        indices=g.graphs[e].indices,
-                        edge_ids=g.graphs[e].edge_ids,
-                        local_row=g.graphs[e].local_row,
-                        node_pb=g.graphs[e].node_pb) for e in etypes}
+      def etype_payload(e):
+        d = dict(indptr=g.graphs[e].indptr, indices=g.graphs[e].indices,
+                 edge_ids=g.graphs[e].edge_ids,
+                 local_row=g.graphs[e].local_row,
+                 node_pb=g.graphs[e].node_pb)
+        if g.graphs[e].edge_weights is not None:
+          d['edge_weights'] = g.graphs[e].edge_weights
+        return d
+      shards = {e: etype_payload(e) for e in etypes}
       feat_shards = {t: dict(array=feats[t].array,
                              id2index=feats[t].id2index,
                              feat_pb=feats[t].feat_pb) for t in types}

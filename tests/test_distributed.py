@@ -282,3 +282,34 @@ def test_dist_weighted_sampling(tmp_path_factory, mesh):
         hits += int((v + 1) % N_NODES in got)
   assert total > 50
   assert hits / total > 0.95, f'{hits}/{total}'
+
+
+def test_dist_hetero_weighted(tmp_path_factory, mesh):
+  from glt_tpu.distributed import DistHeteroGraph, DistHeteroNeighborSampler
+  root = str(tmp_path_factory.mktemp('hw'))
+  i2i = ('item', 'i2i', 'item')
+  ni = 32
+  i = np.arange(ni)
+  ei = np.stack([np.repeat(i, 2),
+                 np.stack([(i+1) % ni, (i+2) % ni], 1).reshape(-1)])
+  w = np.ones(2 * ni, np.float32)
+  w[::2] = 500.0    # (v -> v+1) dominates
+  RandomPartitioner(root, num_parts=N_PARTS, num_nodes={'item': ni},
+                    edge_index={i2i: ei},
+                    edge_weights={i2i: w}).partition()
+  dg = DistHeteroGraph.from_dataset_partitions(mesh, root)
+  assert dg.graphs[i2i].edge_weights is not None
+  s = DistHeteroNeighborSampler(dg, {i2i: [1]}, with_weight=True, seed=0)
+  hits = total = 0
+  for trial in range(10):
+    seeds = ((np.arange(N_PARTS) + trial * N_PARTS) % ni)[:, None]
+    out = s.sample_from_nodes('item', seeds)
+    nodes = np.asarray(out['node']['item'])
+    counts = np.asarray(out['node_count']['item'])
+    for p in range(N_PARTS):
+      v = int(seeds[p, 0])
+      got = set(nodes[p][:counts[p]].tolist()) - {v}
+      if got:
+        total += 1
+        hits += int((v + 1) % ni in got)
+  assert total > 40 and hits / total > 0.9, f'{hits}/{total}'
