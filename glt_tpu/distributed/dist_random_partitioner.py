@@ -85,9 +85,10 @@ class DistRandomPartitioner:
     # multi-host deployments pass bind_addr='0.0.0.0' (or the local
     # interface) plus peer_addrs for the other ranks' hosts
     self.server = RpcServer(bind_addr or master_addr,
-                            master_port + rank)
+                            master_port + rank, auto_start=False)
     self.server.register('push_edges', self.buffer.push_edges)
     self.server.register('push_node_feat', self.buffer.push_node_feat)
+    self.server.start()  # accept only after all callees exist
     self.peer_addrs = peer_addrs or [master_addr] * world_size
     assert len(self.peer_addrs) == world_size
     self.base_port = master_port

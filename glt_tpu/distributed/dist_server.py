@@ -170,13 +170,15 @@ def init_server(num_servers: int, num_clients: int, server_rank: int,
   init_server_context(num_servers, num_clients, server_rank)
   _server = DistServer(dataset, dataset_builder)
   _rpc_server = RpcServer(master_addr,
-                          server_port(master_port, server_rank))
+                          server_port(master_port, server_rank),
+                          auto_start=False)
   for name in ('get_dataset_meta', 'create_sampling_producer',
                'start_new_epoch_sampling', 'fetch_one_sampled_message',
                'get_node_feature', 'get_node_label', 'get_tensor_size',
                'get_edge_index', 'get_edge_size',
                'get_node_partition_id', 'exit'):
     _rpc_server.register(name, getattr(_server, name))
+  _rpc_server.start()  # accept only after all callees exist
   return _server
 
 

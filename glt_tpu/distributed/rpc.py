@@ -46,7 +46,8 @@ class RpcServer:
   """Threaded RPC endpoint with a callee registry
   (the RpcCalleeBase/rpc_register pattern, reference rpc.py:419-473)."""
 
-  def __init__(self, host: str = '127.0.0.1', port: int = 0):
+  def __init__(self, host: str = '127.0.0.1', port: int = 0,
+               auto_start: bool = True):
     self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     self._sock.bind((host, port))
@@ -60,9 +61,20 @@ class RpcServer:
     self._lock = threading.Lock()
     self.register('_barrier', self._barrier)
     self.register('_gather', self._gather)
-    self._accept_thread = threading.Thread(target=self._accept_loop,
-                                           daemon=True)
-    self._accept_thread.start()
+    self._accept_thread = None
+    if auto_start:
+      self.start()
+
+  def start(self) -> None:
+    """Begin accepting connections. Callers that register callees after
+    construction MUST use auto_start=False and call start() once
+    registration is complete — otherwise a fast peer can connect in the
+    window before its callee exists (observed under load as
+    KeyError('push_edges'))."""
+    if self._accept_thread is None:
+      self._accept_thread = threading.Thread(target=self._accept_loop,
+                                             daemon=True)
+      self._accept_thread.start()
 
   def register(self, name: str, fn: Callable) -> None:
     self._callees[name] = fn

@@ -18,6 +18,43 @@ def build_ring_dataset():
   return ring_dataset(num_nodes=40, feat_dim=4)
 
 
+
+
+def _free_port_base(n=2):
+  """Reserve n consecutive-ish free ports via OS assignment; returns a
+  base such that base..base+n-1 are (momentarily) free."""
+  import socket
+  socks, ports = [], []
+  for _ in range(n):
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    socks.append(s)
+    ports.append(s.getsockname()[1])
+  for s in socks:
+    s.close()
+  return ports
+
+
+def _free_consecutive_base(span=2, tries=50):
+  import socket
+  for _ in range(tries):
+    s = socket.socket(); s.bind(('127.0.0.1', 0))
+    base = s.getsockname()[1]; s.close()
+    ok = True
+    for k in range(span):
+      t = socket.socket()
+      try:
+        t.bind(('127.0.0.1', base + k))
+      except OSError:
+        ok = False
+      finally:
+        t.close()
+      if not ok:
+        break
+    if ok:
+      return base
+  raise RuntimeError('no consecutive free ports found')
+
 def test_rpc_roundtrip():
   from glt_tpu.distributed.rpc import RpcClient, RpcServer
   srv = RpcServer()
@@ -148,8 +185,7 @@ def test_dist_random_partitioner_two_ranks(tmp_path):
   parts = []
   errs = []
 
-  import os
-  base_port = 32000 + os.getpid() % 8000   # avoid cross-test collisions
+  base_port = _free_consecutive_base(2)
 
   def run_rank(r):
     try:
@@ -202,7 +238,7 @@ def test_dist_partitioner_output_loads(tmp_path):
   import os
   rows, cols, eids = ring_edges(40)
   feats = np.tile(np.arange(40, dtype=np.float32)[:, None], (1, 4))
-  base_port = 33000 + os.getpid() % 8000
+  base_port = _free_consecutive_base(2)
   parts, errs = [], []
 
   def run_rank(r):
@@ -243,7 +279,7 @@ def test_dist_table_dataset(tmp_path):
   import os
   rows, cols, eids = ring_edges(40)
   feats = np.tile(np.arange(40, dtype=np.float32)[:, None], (1, 4))
-  base_port = 35000 + os.getpid() % 8000
+  base_port = _free_consecutive_base(2)
   out, errs = {}, []
 
   def run_rank(r):
