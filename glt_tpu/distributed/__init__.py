@@ -51,3 +51,9 @@ __all__ += ['DistHeteroGraph', 'DistHeteroNeighborSampler',
 from .dist_random_partitioner import DistRandomPartitioner
 
 __all__ += ['DistRandomPartitioner']
+from .dist_link_loader import DistLinkNeighborLoader
+
+__all__ += ['DistLinkNeighborLoader']
+from .dist_subgraph_loader import DistSubGraphLoader
+
+__all__ += ['DistSubGraphLoader']

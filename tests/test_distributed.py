@@ -313,3 +313,75 @@ def test_dist_hetero_weighted(tmp_path_factory, mesh):
         total += 1
         hits += int((v + 1) % ni in got)
   assert total > 40 and hits / total > 0.9, f'{hits}/{total}'
+
+
+def test_dist_link_neighbor_loader(mesh, part_dir, dist_datasets):
+  from glt_tpu.distributed import DistLinkNeighborLoader
+  from glt_tpu.sampler import NegativeSampling
+  dg = DistGraph.from_dataset_partitions(mesh, part_dir)
+  df = DistFeature.from_dist_datasets(mesh, dist_datasets)
+  # per-device edge pools: device p holds the ring edges of its nodes
+  pools = []
+  for p in range(N_PARTS):
+    owned = np.nonzero(np.asarray(dg.node_pb) == p)[0]
+    src = np.repeat(owned, 2)
+    dst = np.concatenate([(owned + 1) % N_NODES,
+                          (owned + 2) % N_NODES]).reshape(2, -1).T.reshape(-1)
+    # interleave properly: for each v: (v+1), (v+2)
+    dst = np.stack([(owned + 1) % N_NODES, (owned + 2) % N_NODES],
+                   1).reshape(-1)
+    pools.append(np.stack([src, dst]))
+  loader = DistLinkNeighborLoader(
+      dg, [2], pools, dist_feature=df,
+      neg_sampling=NegativeSampling('binary', amount=1),
+      batch_size=4, seed=0)
+  batches = list(loader)
+  assert len(batches) >= 2
+  b = batches[0]
+  eli = np.asarray(b['edge_label_index'])      # [P, 2, 8]
+  nodes = np.asarray(b['node'])
+  for p in range(N_PARTS):
+    n_pos = int(np.asarray(b['n_pos'])[p])
+    src = nodes[p][eli[p, 0, :n_pos]]
+    dst = nodes[p][eli[p, 1, :n_pos]]
+    for u, v in zip(src, dst):
+      assert v in ((u + 1) % N_NODES, (u + 2) % N_NODES)
+    # labels: first batch_size are positives
+    lab = np.asarray(b['edge_label'])[p]
+    np.testing.assert_array_equal(lab[:4], 1.0)
+    np.testing.assert_array_equal(lab[4:], 0.0)
+  # features resolve for all sampled nodes
+  x = np.asarray(b['x'])
+  counts = np.asarray(b['node_count'])
+  for p in range(N_PARTS):
+    np.testing.assert_allclose(x[p][:counts[p], 0],
+                               nodes[p][:counts[p]])
+
+
+def test_dist_subgraph_loader(mesh, part_dir):
+  from glt_tpu.distributed import DistSubGraphLoader
+  dg = DistGraph.from_dataset_partitions(mesh, part_dir)
+  loader = DistSubGraphLoader(
+      dg, num_hops=2, input_nodes_per_device=[
+          np.array([p]) for p in range(N_PARTS)],
+      max_degree=2, batch_size=1, seed=0)
+  b = next(iter(loader))
+  nodes = np.asarray(b['node'])
+  counts = np.asarray(b['node_count'])
+  for p in range(N_PARTS):
+    got = set(nodes[p][:counts[p]].tolist())
+    expect = {p, (p+1) % N_NODES, (p+2) % N_NODES, (p+3) % N_NODES,
+              (p+4) % N_NODES}
+    assert got == expect
+    ind = b['induced'][p]
+    # induced edges: every ring edge within the 2-hop set, each once
+    pairs = {(int(nodes[p][r]), int(nodes[p][c]))
+             for r, c in zip(ind['cols'], ind['rows'])}
+    # (cols=parent? note: out row=child col=parent in dist outputs too)
+    expect_edges = set()
+    for v in expect:
+      for d in (1, 2):
+        if (v + d) % N_NODES in expect:
+          expect_edges.add((v, (v + d) % N_NODES))
+    assert pairs == expect_edges, (pairs, expect_edges)
+    assert len(ind['eids']) == len(set(ind['eids'].tolist()))
