@@ -57,3 +57,6 @@ __all__ += ['DistLinkNeighborLoader']
 from .dist_subgraph_loader import DistSubGraphLoader
 
 __all__ += ['DistSubGraphLoader']
+from .dist_negative import DistRandomNegativeSampler
+
+__all__ += ['DistRandomNegativeSampler']

@@ -8,9 +8,10 @@ list of its edge batch (positives + uniformly drawn negatives) into the
 collective sampler; the dense inducer's first-occurrence labels give
 edge_label_index per device, exactly as the single-device link path.
 
-Negative sampling note: negatives are uniform global pairs (the
-reference's non-strict mode). Strict cross-partition rejection requires
-a global membership exchange and is a follow-up.
+Negative sampling note: non-strict negatives are uniform global pairs;
+``NegativeSampling(strict=True)`` routes proposals through the globally
+strict collective membership check (DistRandomNegativeSampler) — strict
+across ALL partitions, which the reference's local-portion check is not.
 """
 from __future__ import annotations
 
@@ -67,6 +68,11 @@ class DistLinkNeighborLoader:
     self.num_neg = num_neg
     self.sampler = DistNeighborSampler(dist_graph, num_neighbors,
                                        seed=seed)
+    self._strict_neg = None
+    if self.neg_sampling and self.neg_sampling.strict and num_neg:
+      from .dist_negative import DistRandomNegativeSampler
+      self._strict_neg = DistRandomNegativeSampler(
+          dist_graph, trials_num=5, padding=True)
     self.feature = dist_feature
 
   def __len__(self):
@@ -75,7 +81,15 @@ class DistLinkNeighborLoader:
       return n // self.batch_size
     return (n + self.batch_size - 1) // self.batch_size
 
-  def _make_seeds(self, lo: int, orders) -> tuple:
+  def _strict_negatives(self):
+    if self._strict_neg is None:
+      return None, None
+    import jax
+    rows, cols, _ = self._strict_neg.sample(self.num_neg)
+    return np.asarray(rows), np.asarray(cols)
+
+  def _make_seeds(self, lo: int, orders, neg_rows=None,
+                  neg_cols=None) -> tuple:
     bs, num_neg = self.batch_size, self.num_neg
     seeds = np.zeros((self.n_dev, self.seeds_per_device), np.int64)
     n_valid = np.zeros(self.n_dev, np.int32)
@@ -92,11 +106,15 @@ class DistLinkNeighborLoader:
         src = np.concatenate([src, self.edges[p][0][pad]])
         dst = np.concatenate([dst, self.edges[p][1][pad]])
       if self.neg_sampling and self.neg_sampling.is_binary():
-        ns = self.rng.integers(0, self.g.num_nodes, num_neg)
-        nd = self.rng.integers(0, self.g.num_nodes, num_neg)
+        if neg_rows is not None:
+          ns, nd = neg_rows[p], neg_cols[p]
+        else:
+          ns = self.rng.integers(0, self.g.num_nodes, num_neg)
+          nd = self.rng.integers(0, self.g.num_nodes, num_neg)
         parts = [np.concatenate([src, ns]), np.concatenate([dst, nd])]
       elif self.neg_sampling:
-        nd = self.rng.integers(0, self.g.num_nodes, num_neg)
+        nd = (neg_cols[p] if neg_cols is not None
+              else self.rng.integers(0, self.g.num_nodes, num_neg))
         parts = [src, np.concatenate([dst, nd])]
       else:
         parts = [src, dst]
@@ -110,7 +128,9 @@ class DistLinkNeighborLoader:
                else np.arange(e.shape[1])) for e in self.edges]
     for it in range(len(self)):
       lo = it * self.batch_size
-      seeds, n_valid, n_pos = self._make_seeds(lo, orders)
+      neg_rows, neg_cols = self._strict_negatives()
+      seeds, n_valid, n_pos = self._make_seeds(lo, orders, neg_rows,
+                                               neg_cols)
       out = self.sampler.sample_from_nodes(seeds, n_valid)
       bs, num_neg = self.batch_size, self.num_neg
       inv = np.asarray(out['seed_labels'])      # [P, seeds_per_device]

@@ -67,3 +67,19 @@ def all_to_all(x: jax.Array, axis_name: str) -> jax.Array:
   y = jax.lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
                          tiled=False)
   return y.reshape((n,) + x.shape[1:])
+
+
+def bucket_payload(values: jax.Array, meta: BucketMeta, n_shards: int,
+                   fill_value=0) -> jax.Array:
+  """Pack a companion payload with the SAME ordering as an existing
+  bucket_by_owner call (e.g. the col of a (row, col) pair routed by the
+  row's owner)."""
+  b = values.shape[0]
+  vals_sorted = jnp.take(values, meta.order)
+  ok = meta.owner_sorted < n_shards
+  buckets = jnp.full((n_shards + 1, b), fill_value, values.dtype)
+  buckets = buckets.at[
+      jnp.where(ok, meta.owner_sorted, n_shards),
+      jnp.where(ok, meta.pos_in_bucket, 0)].set(
+          jnp.where(ok, vals_sorted, fill_value))
+  return buckets[:n_shards]

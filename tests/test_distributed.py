@@ -385,3 +385,60 @@ def test_dist_subgraph_loader(mesh, part_dir):
           expect_edges.add((v, (v + d) % N_NODES))
     assert pairs == expect_edges, (pairs, expect_edges)
     assert len(ind['eids']) == len(set(ind['eids'].tolist()))
+
+
+def test_dist_strict_negative_sampling(mesh, part_dir):
+  from glt_tpu.distributed import DistRandomNegativeSampler
+  dg = DistGraph.from_dataset_partitions(mesh, part_dir)
+  s = DistRandomNegativeSampler(dg, trials_num=6, padding=False)
+  rows, cols, mask = s.sample(32, key=jax.random.key(0))
+  rows, cols, mask = map(np.asarray, (rows, cols, mask))
+  assert mask.sum() > 100  # plenty of valid negatives on a sparse ring
+  ring = {(v, (v + 1) % N_NODES) for v in range(N_NODES)} | \
+         {(v, (v + 2) % N_NODES) for v in range(N_NODES)}
+  for p in range(N_PARTS):
+    for r, c in zip(rows[p][mask[p]], cols[p][mask[p]]):
+      assert (int(r), int(c)) not in ring, (r, c)
+
+
+def test_dist_strict_negative_rejects_on_dense_graph(tmp_path_factory,
+                                                     mesh):
+  # complete digraph: strict mode finds nothing without padding
+  root = str(tmp_path_factory.mktemp('dense'))
+  n = 8
+  r, c = np.meshgrid(np.arange(n), np.arange(n), indexing='ij')
+  RandomPartitioner(root, num_parts=N_PARTS, num_nodes=n,
+                    edge_index=np.stack([r.reshape(-1), c.reshape(-1)])
+                    ).partition()
+  from glt_tpu.distributed import DistRandomNegativeSampler
+  dg = DistGraph.from_dataset_partitions(mesh, root)
+  s = DistRandomNegativeSampler(dg, trials_num=4, padding=False)
+  _, _, mask = s.sample(16, key=jax.random.key(1))
+  assert not np.asarray(mask).any()
+
+
+def test_dist_link_loader_strict_negatives(mesh, part_dir, dist_datasets):
+  from glt_tpu.distributed import DistLinkNeighborLoader
+  from glt_tpu.sampler import NegativeSampling
+  dg = DistGraph.from_dataset_partitions(mesh, part_dir)
+  pools = []
+  for p in range(N_PARTS):
+    owned = np.nonzero(np.asarray(dg.node_pb) == p)[0]
+    src = np.repeat(owned, 2)
+    dst = np.stack([(owned + 1) % N_NODES, (owned + 2) % N_NODES],
+                   1).reshape(-1)
+    pools.append(np.stack([src, dst]))
+  loader = DistLinkNeighborLoader(
+      dg, [2], pools,
+      neg_sampling=NegativeSampling('binary', amount=1, strict=True),
+      batch_size=4, seed=0)
+  b = next(iter(loader))
+  eli = np.asarray(b['edge_label_index'])
+  nodes = np.asarray(b['node'])
+  ring = {(v, (v + 1) % N_NODES) for v in range(N_NODES)} | \
+         {(v, (v + 2) % N_NODES) for v in range(N_NODES)}
+  for p in range(N_PARTS):
+    neg_src = nodes[p][eli[p, 0, 4:]]
+    neg_dst = nodes[p][eli[p, 1, 4:]]
+    for u, v in zip(neg_src, neg_dst):
+      assert (int(u), int(v)) not in ring
