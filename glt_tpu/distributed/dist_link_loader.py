@@ -73,6 +73,8 @@ class DistLinkNeighborLoader:
       from .dist_negative import DistRandomNegativeSampler
       self._strict_neg = DistRandomNegativeSampler(
           dist_graph, trials_num=5, padding=True)
+    # reproducible negative stream derived from the loader's seed
+    self._neg_key = jax.random.key(seed if seed is not None else 0)
     self.feature = dist_feature
 
   def __len__(self):
@@ -81,11 +83,17 @@ class DistLinkNeighborLoader:
       return n // self.batch_size
     return (n + self.batch_size - 1) // self.batch_size
 
-  def _strict_negatives(self):
+  def _strict_negatives(self, it: int, srcs=None):
+    """Binary mode: free strict pairs. Triplet mode: strict dsts for
+    the batch's OWN sources (membership tested on the emitted pairs).
+    Keys derive from the loader seed + iteration (reproducible)."""
     if self._strict_neg is None:
       return None, None
-    import jax
-    rows, cols, _ = self._strict_neg.sample(self.num_neg)
+    key = jax.random.fold_in(self._neg_key, it)
+    if self.neg_sampling.is_binary():
+      rows, cols, _ = self._strict_neg.sample(self.num_neg, key=key)
+      return np.asarray(rows), np.asarray(cols)
+    rows, cols, _ = self._strict_neg.sample_dst(srcs, key=key)
     return np.asarray(rows), np.asarray(cols)
 
   def _make_seeds(self, lo: int, orders, neg_rows=None,
@@ -128,7 +136,23 @@ class DistLinkNeighborLoader:
                else np.arange(e.shape[1])) for e in self.edges]
     for it in range(len(self)):
       lo = it * self.batch_size
-      neg_rows, neg_cols = self._strict_negatives()
+      neg_rows = neg_cols = None
+      if self._strict_neg is not None:
+        srcs = None
+        if self.neg_sampling.is_triplet():
+          # per-positive sources, tiled to the negative amount
+          amount = self.num_neg // max(self.batch_size, 1)
+          srcs = np.zeros((self.n_dev, self.num_neg), np.int64)
+          for p in range(self.n_dev):
+            sel = orders[p][lo:lo + self.batch_size]
+            if sel.shape[0] == 0:
+              continue
+            s = self.edges[p][0][sel]
+            if s.shape[0] < self.batch_size:
+              s = np.concatenate(
+                  [s, np.full(self.batch_size - s.shape[0], s[-1])])
+            srcs[p] = np.tile(s, max(amount, 1))[:self.num_neg]
+        neg_rows, neg_cols = self._strict_negatives(it, srcs)
       seeds, n_valid, n_pos = self._make_seeds(lo, orders, neg_rows,
                                                neg_cols)
       out = self.sampler.sample_from_nodes(seeds, n_valid)

@@ -12,12 +12,10 @@ anywhere in the partitioned graph.
 """
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from ..ops.negative import edge_in_csr
 from ..parallel.collectives import (
@@ -78,18 +76,30 @@ class DistRandomNegativeSampler:
     axis = self.axis
     padding = self.padding
 
-    def device_fn(indptr, indices, local_row, node_pb, key):
+    def device_fn(indptr, indices, local_row, node_pb, key, src_pool):
       shards = dict(indptr=indptr[0], indices=indices[0],
                     local_row=local_row[0], node_pb=node_pb)
       member = make_dist_edge_membership(shards, g.num_nodes, n_parts,
                                          g.max_rows, axis)
       my_key = jax.random.fold_in(key[0], jax.lax.axis_index(axis))
       kr, kc = jax.random.split(my_key)
-      prop_r = jax.random.randint(kr, (t, req_num), 0, g.num_nodes,
-                                  dtype=jnp.int32)
+      if src_pool is None:
+        prop_r = jax.random.randint(kr, (t, req_num), 0, g.num_nodes,
+                                    dtype=jnp.int32)
+      else:
+        # per-source mode: rows are the caller's fixed sources
+        prop_r = jnp.broadcast_to(src_pool[0].astype(jnp.int32),
+                                  (t, req_num))
       prop_c = jax.random.randint(kc, (t, req_num), 0, g.num_nodes,
                                   dtype=jnp.int32)
-      exists = member(prop_r.reshape(-1), prop_c.reshape(-1),
+      # the store's row axis is src for edge_dir='out' and dst for 'in';
+      # proposals are (src, dst) pairs, so membership queries swap on 'in'
+      # (single-device parity: sampler/negative_sampler.py edge_dir swap)
+      if g.edge_dir == 'in':
+        q_rows, q_cols = prop_c, prop_r
+      else:
+        q_rows, q_cols = prop_r, prop_c
+      exists = member(q_rows.reshape(-1), q_cols.reshape(-1),
                       jnp.ones(t * req_num, bool)).reshape(t, req_num)
       ok = ~exists
       first = jnp.argmax(ok, axis=0)
@@ -105,25 +115,44 @@ class DistRandomNegativeSampler:
       return rows[None], cols[None], mask[None]
 
     sp = P(self.axis)
-    fn = jax.shard_map(
-        device_fn, mesh=self.mesh,
-        in_specs=(sp, sp, sp, P(), sp),
-        out_specs=(sp, sp, sp), check_vma=False)
 
-    def step(key):
-      n_dev = self.mesh.shape[self.axis]
-      keys = jax.random.split(key, n_dev)
-      return fn(g.indptr, g.indices, g.local_row, g.node_pb, keys)
+    def make(with_src):
+      specs = (sp, sp, sp, P(), sp, sp if with_src else None)
+      fn = jax.shard_map(
+          device_fn, mesh=self.mesh,
+          in_specs=specs, out_specs=(sp, sp, sp), check_vma=False)
 
-    return jax.jit(step)
+      def step(key, src_pool=None):
+        n_dev = self.mesh.shape[self.axis]
+        keys = jax.random.split(key, n_dev)
+        return fn(g.indptr, g.indices, g.local_row, g.node_pb, keys,
+                  src_pool)
+      return jax.jit(step)
+    return make(False), make(True)
+
+  def _fns(self, req_num: int):
+    if req_num not in self._fn_cache:
+      self._fn_cache[req_num] = self._build(req_num)
+    return self._fn_cache[req_num]
 
   def sample(self, req_num_per_device: int, key=None):
     """Returns (rows, cols, mask) each [P, req] — per-device negative
-    pairs, globally strict."""
-    if req_num_per_device not in self._fn_cache:
-      self._fn_cache[req_num_per_device] = self._build(
-          req_num_per_device)
+    (src, dst) pairs, globally strict."""
     if key is None:
       from ..utils.rng import RandomSeedManager
       key = RandomSeedManager.getInstance().nextKey()
-    return self._fn_cache[req_num_per_device](key)
+    free_fn, _ = self._fns(req_num_per_device)
+    return free_fn(key)
+
+  def sample_dst(self, src_per_device, key=None):
+    """Per-source strict destinations (triplet mode): for each given
+    src, draw dsts until (src, dst) is not an edge anywhere. Returns
+    (rows, cols, mask) with rows == the given sources."""
+    src_per_device = jnp.asarray(np.asarray(src_per_device), jnp.int32)
+    if key is None:
+      from ..utils.rng import RandomSeedManager
+      key = RandomSeedManager.getInstance().nextKey()
+    _, src_fn = self._fns(src_per_device.shape[1])
+    from jax.sharding import NamedSharding
+    shard = NamedSharding(self.mesh, P(self.axis))
+    return src_fn(key, jax.device_put(src_per_device, shard))

@@ -32,19 +32,14 @@ def bucket_by_owner(ids: jax.Array, owner: jax.Array, n_shards: int,
   """
   b = ids.shape[0]
   order = jnp.argsort(owner, stable=True)
-  ids_sorted = jnp.take(ids, order)
   owner_sorted = jnp.take(owner, order)
   counts = jnp.bincount(jnp.minimum(owner_sorted, n_shards),
                         length=n_shards + 1)[:n_shards]
   offsets = jnp.cumsum(counts) - counts
   pos = jnp.arange(b) - jnp.take(
       offsets, jnp.minimum(owner_sorted, n_shards - 1))
-  ok = owner_sorted < n_shards
-  buckets = jnp.full((n_shards + 1, b), fill_value, ids.dtype)
-  buckets = buckets.at[
-      jnp.where(ok, owner_sorted, n_shards),
-      jnp.where(ok, pos, 0)].set(jnp.where(ok, ids_sorted, fill_value))
-  return buckets[:n_shards], BucketMeta(order, owner_sorted, pos)
+  meta = BucketMeta(order, owner_sorted, pos)
+  return bucket_payload(ids, meta, n_shards, fill_value), meta
 
 
 def unbucket(resp: jax.Array, meta: BucketMeta, n_shards: int,

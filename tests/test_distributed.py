@@ -442,3 +442,56 @@ def test_dist_link_loader_strict_negatives(mesh, part_dir, dist_datasets):
     neg_dst = nodes[p][eli[p, 1, 4:]]
     for u, v in zip(neg_src, neg_dst):
       assert (int(u), int(v)) not in ring
+
+
+def test_dist_strict_triplet_negatives(mesh, part_dir):
+  from glt_tpu.distributed import DistLinkNeighborLoader
+  from glt_tpu.sampler import NegativeSampling
+  dg = DistGraph.from_dataset_partitions(mesh, part_dir)
+  pools = []
+  for p in range(N_PARTS):
+    owned = np.nonzero(np.asarray(dg.node_pb) == p)[0]
+    src = np.repeat(owned, 2)
+    dst = np.stack([(owned + 1) % N_NODES, (owned + 2) % N_NODES],
+                   1).reshape(-1)
+    pools.append(np.stack([src, dst]))
+  loader = DistLinkNeighborLoader(
+      dg, [2], pools,
+      neg_sampling=NegativeSampling('triplet', amount=2, strict=True),
+      batch_size=4, seed=0)
+  b = next(iter(loader))
+  nodes = np.asarray(b['node'])
+  si = np.asarray(b['src_index'])
+  dni = np.asarray(b['dst_neg_index'])
+  ring = {(v, (v + 1) % N_NODES) for v in range(N_NODES)} | \
+         {(v, (v + 2) % N_NODES) for v in range(N_NODES)}
+  for p in range(N_PARTS):
+    srcs = nodes[p][si[p]]
+    # the emitted (src, dst_neg) pairs themselves must be non-edges
+    negs = nodes[p][dni[p].reshape(-1)].reshape(dni[p].shape)
+    for i, s in enumerate(srcs):
+      ds_ = negs[i] if negs.ndim == 2 else [negs[i]]
+      for d in np.atleast_1d(ds_):
+        assert (int(s), int(d)) not in ring, (s, d)
+
+
+def test_dist_strict_negatives_reproducible(mesh, part_dir):
+  from glt_tpu.distributed import DistLinkNeighborLoader
+  from glt_tpu.sampler import NegativeSampling
+  dg = DistGraph.from_dataset_partitions(mesh, part_dir)
+  pools = []
+  for p in range(N_PARTS):
+    owned = np.nonzero(np.asarray(dg.node_pb) == p)[0]
+    src = np.repeat(owned, 2)
+    dst = np.stack([(owned + 1) % N_NODES, (owned + 2) % N_NODES],
+                   1).reshape(-1)
+    pools.append(np.stack([src, dst]))
+  def first_batch():
+    loader = DistLinkNeighborLoader(
+        dg, [2], pools,
+        neg_sampling=NegativeSampling('binary', amount=1, strict=True),
+        batch_size=4, seed=7)
+    b = next(iter(loader))
+    return np.asarray(b['node'])[np.arange(N_PARTS)[:, None],
+                                 np.asarray(b['edge_label_index'])[:, 0]]
+  np.testing.assert_array_equal(first_batch(), first_batch())
