@@ -1,0 +1,91 @@
+"""Unit tests for utility surfaces: typing conventions, rng manager,
+profiling meters, size parsing, mesh helpers."""
+import time
+
+import jax
+import numpy as np
+
+from glt_tpu.typing import as_str, reverse_edge_type
+from glt_tpu.utils import (
+    RandomSeedManager, id2idx, merge_dict, parse_size, seed_everything,
+)
+from glt_tpu.utils.common import CastMixin
+from glt_tpu.utils.profile import ThroughputMeter, Timer
+
+
+def test_reverse_edge_type_conventions():
+  assert reverse_edge_type(('u', 'rel', 'i')) == ('i', 'rev_rel', 'u')
+  assert reverse_edge_type(('i', 'rev_rel', 'u')) == ('u', 'rel', 'i')
+  # same-type relations keep their name
+  assert reverse_edge_type(('i', 'link', 'i')) == ('i', 'link', 'i')
+  assert as_str(('a', 'b', 'c')) == 'a__b__c'
+  assert as_str('node') == 'node'
+
+
+def test_seed_manager_reproducible_streams():
+  m = RandomSeedManager.getInstance()
+  m.setSeed(123)
+  k1, k2 = m.nextKey(), m.nextKey()
+  m.setSeed(123)
+  k1b, k2b = m.nextKey(), m.nextKey()
+  assert jax.random.key_data(k1).tolist() == \
+      jax.random.key_data(k1b).tolist()
+  assert jax.random.key_data(k1).tolist() != \
+      jax.random.key_data(k2).tolist()
+  assert jax.random.key_data(k2).tolist() == \
+      jax.random.key_data(k2b).tolist()
+
+
+def test_id2idx_and_merge_dict():
+  out = id2idx(np.array([5, 2, 9]))
+  assert out[5] == 0 and out[2] == 1 and out[9] == 2
+  d = {}
+  merge_dict({'a': 1}, d)
+  merge_dict({'a': 2, 'b': 3}, d)
+  assert d == {'a': [1, 2], 'b': [3]}
+
+
+def test_parse_size():
+  assert parse_size(1024) == 1024
+  assert parse_size('2KB') == 2048
+  assert parse_size('1.5MB') == int(1.5 * 1024 ** 2)
+  assert parse_size('3g') == 3 * 1024 ** 3
+  import pytest
+  with pytest.raises(ValueError):
+    parse_size('10parsecs')
+
+
+def test_cast_mixin():
+  import dataclasses
+
+  @dataclasses.dataclass
+  class Cfg(CastMixin):
+    a: int
+    b: int = 2
+
+  assert Cfg.cast(None) is None
+  c = Cfg.cast({'a': 1, 'b': 5})
+  assert (c.a, c.b) == (1, 5)
+  assert Cfg.cast((7,)).a == 7
+  same = Cfg(3)
+  assert Cfg.cast(same) is same
+
+
+def test_timer_and_meter():
+  t = Timer()
+  with t:
+    time.sleep(0.01)
+  assert t.elapsed >= 0.01
+  m = ThroughputMeter('edges')
+  m.update(1000, 0.5)
+  m.update(1000, 0.5)
+  assert abs(m.rate - 2000) < 1e-6
+  assert 'edges/s' in m.report()
+
+
+def test_mesh_helpers():
+  from glt_tpu.parallel import make_mesh, replicated, row_sharded
+  mesh = make_mesh(8)
+  assert mesh.shape['data'] == 8
+  assert replicated(mesh).spec == jax.sharding.PartitionSpec()
+  assert row_sharded(mesh).spec == jax.sharding.PartitionSpec('data')
