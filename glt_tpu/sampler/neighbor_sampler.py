@@ -436,12 +436,41 @@ class NeighborSampler(BaseSampler):
         max_degree=max_degree or g.topo.max_degree,
         edge_ids=g.edge_ids, with_edge=self.with_edge)
 
-  def sample_prob(self, train_idx, node_count: int) -> jax.Array:
+  def sample_prob(self, train_idx, node_count=None):
     """Pre-sampling hotness estimation (reference
     neighbor_sampler.py:500-627 + CalNbrProbKernel): propagate access
-    probability from the training seeds through the fanouts."""
-    assert not self.is_hetero, 'sample_prob currently homo-only'
+    probability from the training seeds through the fanouts.
+
+    Homo: ``train_idx`` array + ``node_count`` int -> [N] probs.
+    Hetero: ``train_idx`` = (seed_type, ids); ``node_count`` optional
+    Dict[ntype, int] (defaults to the inferred counts); returns
+    Dict[ntype, probs], pushing probability across edge types each hop
+    (the per-etype loop of the reference's hetero estimator).
+    """
+    if self.is_hetero:
+      seed_type, ids = train_idx
+      counts = dict(node_count or self._node_counts)
+      probs = {t: jnp.zeros((counts[t],), jnp.float32) for t in counts}
+      probs[seed_type] = probs[seed_type].at[
+          jnp.asarray(as_numpy(ids))].set(1.0)
+      acc = {t: p for t, p in probs.items()}
+      trav = self._traversal_types()
+      for h in range(self.num_hops):
+        nxt = {t: jnp.zeros((counts[t],), jnp.float32) for t in counts}
+        for etype, (row_t, col_t) in trav.items():
+          g = self.graph[etype]
+          k = self.num_neighbors[etype][h]
+          if k == 0:
+            continue
+          contrib = neighbor_probs(g.indptr, g.indices, acc[row_t], k,
+                                   counts[col_t])
+          nxt[col_t] = jnp.minimum(nxt[col_t] + contrib, 1.0)
+        acc = nxt
+        probs = {t: jnp.minimum(probs[t] + acc[t], 1.0) for t in counts}
+      return probs
+
     g: Graph = self.graph
+    assert node_count is not None
     probs = jnp.zeros((node_count,), jnp.float32)
     probs = probs.at[jnp.asarray(as_numpy(train_idx))].set(1.0)
     acc = probs

@@ -163,3 +163,16 @@ def test_hetero_num_sampled_counts(hetero):
       np.asarray(out.num_sampled_nodes['user']), [2, 0])
   np.testing.assert_array_equal(
       np.asarray(out.num_sampled_nodes['item']), [0, 4])
+
+
+def test_hetero_sample_prob(hetero):
+  u2i = ('user', 'u2i', 'item')
+  i2i = ('item', 'i2i', 'item')
+  s = NeighborSampler(hetero.graph, {u2i: [2], i2i: [2]}, seed=0)
+  probs = s.sample_prob(('user', np.array([3])))
+  u = np.asarray(probs['user'])
+  it = np.asarray(probs['item'])
+  assert u[3] == 1.0 and u.sum() == 1.0      # only the seed user
+  # user 3 -> items {6, 7} (deg 2 <= fanout 2 -> prob 1)
+  assert it[6] == 1.0 and it[7] == 1.0
+  assert it[[0, 1, 2, 3]].sum() == 0.0
