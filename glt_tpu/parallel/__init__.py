@@ -5,6 +5,8 @@ from .train import SPMDSageTrainStep
 __all__ = ['make_mesh', 'replicated', 'row_sharded', 'ShardedFeature',
            'SPMDSageTrainStep']
 from . import multihost
-from .collectives import all_to_all, bucket_by_owner, unbucket
+from .collectives import (all_to_all, bucket_by_owner, bucket_payload,
+                          sharded_segment_mean, unbucket)
 
-__all__ += ['multihost', 'all_to_all', 'bucket_by_owner', 'unbucket']
+__all__ += ['multihost', 'all_to_all', 'bucket_by_owner',
+            'bucket_payload', 'sharded_segment_mean', 'unbucket']

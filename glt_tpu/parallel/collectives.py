@@ -78,3 +78,36 @@ def bucket_payload(values: jax.Array, meta: BucketMeta, n_shards: int,
       jnp.where(ok, meta.pos_in_bucket, 0)].set(
           jnp.where(ok, vals_sorted, fill_value))
   return buckets[:n_shards]
+
+
+def sharded_segment_mean(msgs: jax.Array, targets: jax.Array,
+                         mask: jax.Array, num_segments: int,
+                         axis_name: str) -> jax.Array:
+  """Context-parallel neighborhood aggregation (call inside shard_map).
+
+  The graph-domain analogue of sequence/context parallelism (SURVEY.md
+  §5.7: the 'sequence length' axis of this domain is neighborhood size):
+  when a node's neighbor list is too large for one chip, its message
+  rows are sharded across the mesh; every device reduces its local
+  shard with a masked segment-sum and the partial sums/counts are
+  psum'd over ICI — a ring-attention-style reduction where the softmax
+  is replaced by the GNN's mean.
+
+  Args:
+    msgs: [M_local, D] this device's message shard.
+    targets: [M_local] destination segment per message.
+    mask: [M_local] validity.
+    num_segments: global segment count (static).
+    axis_name: mesh axis to reduce over.
+
+  Returns [num_segments, D] — identical on every device.
+  """
+  seg = jnp.where(mask, targets, num_segments)
+  total = jax.ops.segment_sum(
+      jnp.where(mask[:, None], msgs, 0.0), seg, num_segments + 1
+  )[:num_segments]
+  cnt = jax.ops.segment_sum(mask.astype(msgs.dtype), seg,
+                            num_segments + 1)[:num_segments]
+  total = jax.lax.psum(total, axis_name)
+  cnt = jax.lax.psum(cnt, axis_name)
+  return total / jnp.maximum(cnt[:, None], 1.0)
