@@ -77,6 +77,11 @@ class ShmQueue:
     self._handle = lib.shmq_attach(shmid)
     if not self._handle:
       raise OSError('shmq_attach failed')
+    # peek+dequeue is a two-step protocol; serialize same-process
+    # consumers (cross-process atomicity comes from the retry loop in
+    # dequeue(): the C side refuses with -EMSGSIZE without consuming
+    # when the block changed size under us)
+    self._recv_lock = threading.Lock()
 
   def enqueue(self, data: bytes, timeout_ms: int = 60_000) -> None:
     rc = get_lib().shmq_enqueue(self._handle, data, len(data),
@@ -87,19 +92,27 @@ class ShmQueue:
       raise OSError(-rc, 'shmq_enqueue failed')
 
   def dequeue(self, timeout_ms: int = 60_000) -> bytes:
+    import time as _time
     lib = get_lib()
-    size = lib.shmq_peek_size(self._handle, timeout_ms)
-    if size == -110:
-      raise QueueTimeoutError('dequeue timed out')
-    if size < 0:
-      raise OSError(int(-size), 'shmq_peek_size failed')
-    buf = ctypes.create_string_buffer(int(size))
-    got = lib.shmq_dequeue(self._handle, buf, int(size), timeout_ms)
-    if got == -110:
-      raise QueueTimeoutError('dequeue timed out')
-    if got < 0:
-      raise OSError(int(-got), 'shmq_dequeue failed')
-    return buf.raw[:got]
+    deadline = _time.monotonic() + timeout_ms / 1000
+    with self._recv_lock:
+      while True:
+        remaining = max(int((deadline - _time.monotonic()) * 1000), 1)
+        size = lib.shmq_peek_size(self._handle, remaining)
+        if size == -110:
+          raise QueueTimeoutError('dequeue timed out')
+        if size < 0:
+          raise OSError(int(-size), 'shmq_peek_size failed')
+        buf = ctypes.create_string_buffer(int(size))
+        remaining = max(int((deadline - _time.monotonic()) * 1000), 1)
+        got = lib.shmq_dequeue(self._handle, buf, int(size), remaining)
+        if got == -110:
+          raise QueueTimeoutError('dequeue timed out')
+        if got == -90:  # -EMSGSIZE: another consumer won the race and
+          continue      # the head block changed; re-peek
+        if got < 0:
+          raise OSError(int(-got), 'shmq_dequeue failed')
+        return buf.raw[:got]
 
   def size(self) -> int:
     return int(get_lib().shmq_size(self._handle))

@@ -185,3 +185,55 @@ def test_mllog_format(capsys):
     assert l.startswith(':::MLLOG ')
     rec = _json.loads(l[len(':::MLLOG '):])
     assert 'key' in rec and 'time_ms' in rec
+
+
+def _stress_producer(chan, pid, n):
+  for i in range(n):
+    chan.send({'pid': np.array([pid]), 'i': np.array([i]),
+               'data': np.full((pid + 1) * 7, i, np.int32)})
+
+
+def test_shm_channel_multi_producer_multi_consumer():
+  """3 producer processes, 2 consumer threads, one 64KB ring: every
+  message arrives exactly once, per-producer order preserved."""
+  import threading
+  chan = ShmChannel(capacity_bytes=1 << 16)
+  try:
+    ctx = mp.get_context('spawn')
+    n = 60
+    procs = [ctx.Process(target=_stress_producer, args=(chan, p, n))
+             for p in range(3)]
+    for p in procs:
+      p.start()
+    got = []
+    lock = threading.Lock()
+    def consume(k):
+      while True:
+        with lock:
+          if len(got) >= 3 * n:
+            return
+        try:
+          msg = chan.recv(timeout_ms=20_000)
+        except Exception:
+          return
+        with lock:
+          got.append((int(msg['pid'][0]), int(msg['i'][0]),
+                      msg['data'].copy()))
+    threads = [threading.Thread(target=consume, args=(k,))
+               for k in range(2)]
+    for t in threads:
+      t.start()
+    for p in procs:
+      p.join(timeout=60)
+    for t in threads:
+      t.join(timeout=60)
+    assert len(got) == 3 * n
+    per = {0: [], 1: [], 2: []}
+    for pid, i, data in got:
+      per[pid].append(i)
+      assert data.shape[0] == (pid + 1) * 7
+      assert (data == i).all()
+    for pid in per:
+      assert sorted(per[pid]) == list(range(n))
+  finally:
+    chan.close()
