@@ -314,3 +314,33 @@ def test_dist_table_dataset(tmp_path):
       np.load(str(tmp_path / f'part{r}' / 'graph' / 'data.npz'))['eids']
       for r in range(2)])
   assert np.unique(all_eids).shape[0] == 80
+
+
+def test_mp_loader_dead_worker_times_out_cleanly():
+  """Failure detection: if sampling workers die mid-epoch, the consumer
+  gets a clean QueueTimeoutError instead of hanging (the reference's
+  MP_STATUS_CHECK watchdog behavior)."""
+  from glt_tpu.channel import QueueTimeoutError
+  from glt_tpu.distributed import MpDistSamplingWorkerOptions, \
+      MpNeighborLoader
+  loader = MpNeighborLoader(
+      build_ring_dataset, [2], input_nodes=np.arange(40),
+      batch_size=8, collect_features=False,
+      worker_options=MpDistSamplingWorkerOptions(
+          num_workers=1, rpc_timeout=25.0),
+      seed=0)
+  try:
+    it = iter(loader)
+    first = next(it)                   # epoch running
+    # kill the worker hard mid-epoch
+    for w in loader.producer._workers:
+      w.terminate()
+      w.join(timeout=10)
+    with pytest.raises((QueueTimeoutError, StopIteration)):
+      # drain: either the remaining buffered batches end cleanly via
+      # StopIteration (epoch end marker was already queued) or the
+      # consumer times out — never a hang
+      for _ in range(100):
+        next(it)
+  finally:
+    loader.shutdown()
