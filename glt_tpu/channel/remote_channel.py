@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import Callable, List, Optional
+from typing import Callable, List
 
 from .base import ChannelBase, SampleMessage
 from .shm import QueueTimeoutError

@@ -8,7 +8,7 @@ API parity and ignored.
 from __future__ import annotations
 
 from .base import ChannelBase, SampleMessage, pack_message, unpack_message
-from .shm import QueueTimeoutError, ShmQueue
+from .shm import ShmQueue
 
 
 class ShmChannel(ChannelBase):

@@ -17,7 +17,6 @@ from ..typing import EdgeType, GraphMode, NodeType, Split
 from ..utils import as_numpy
 from .feature import Feature
 from .graph import Graph
-from .reorder import sort_by_in_degree
 from .topology import Topology
 
 GraphLike = Union[Graph, Dict[EdgeType, Graph]]

@@ -9,7 +9,6 @@ on availability, matching the reference's optional-extension pattern.
 """
 from __future__ import annotations
 
-from typing import Optional
 
 
 def _require_vineyard():

@@ -9,14 +9,14 @@ pytrees as the inline loaders, so a training loop is mode-agnostic.
 """
 from __future__ import annotations
 
-from typing import Callable, List, Optional, Union
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..channel import (
-    QueueTimeoutError, RemoteReceivingChannel, ShmChannel, pack_message,
+    RemoteReceivingChannel, ShmChannel, pack_message,
     unpack_message,
 )
 from ..channel.mp_channel import MpChannel

@@ -7,9 +7,9 @@ shutdown choreography (client barrier -> client 0 tells servers to exit
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict
 
-from .dist_context import get_context, init_client_context
+from .dist_context import init_client_context
 from .dist_server import server_port
 from .rpc import RpcClient
 

@@ -9,14 +9,13 @@ by glt_tpu.partition.
 """
 from __future__ import annotations
 
-import os
 from typing import Dict, Optional, Union
 
 import numpy as np
 
 from ..data import Dataset, Feature
 from ..partition import (
-    PartitionBook, cat_feature_cache, load_meta, load_partition,
+    PartitionBook, cat_feature_cache, load_partition,
 )
 from ..typing import EdgeType, GraphMode, NodeType
 from ..utils import as_numpy

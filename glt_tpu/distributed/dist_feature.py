@@ -11,7 +11,7 @@ and the per-partition dense id2index maps it to the local row.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -19,7 +19,6 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.collectives import all_to_all, bucket_by_owner, unbucket
-from ..partition import PartitionBook
 from ..utils import as_numpy
 from .dist_graph import _pb_dense
 

@@ -18,14 +18,14 @@ the reference's per-partition load imbalance.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..data import Graph, Topology
+from ..data import Topology
 from ..partition import PartitionBook, RangePartitionBook, \
     TablePartitionBook
 from ..typing import GraphPartitionData

@@ -13,7 +13,7 @@ for the all_to_all version.
 from __future__ import annotations
 
 import functools
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -22,7 +22,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.pipeline import multihop_sample_hetero
 from ..ops.unique import dense_make_tables
-from ..sampler.base import HeteroSamplerOutput
 from ..typing import EdgeType, NodeType, reverse_edge_type
 from ..utils import as_numpy
 from ..utils.rng import RandomSeedManager

@@ -19,7 +19,6 @@ from __future__ import annotations
 
 from typing import Iterator, Optional, Sequence
 
-import jax
 import numpy as np
 
 from ..sampler.base import SamplingConfig

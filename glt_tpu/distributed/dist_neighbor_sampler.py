@@ -30,7 +30,6 @@ from ..ops.pipeline import edge_hop_offsets, multihop_sample
 from ..ops.sample import sample_neighbors
 from ..ops.unique import dense_make_tables
 from ..parallel.collectives import all_to_all, bucket_by_owner, unbucket
-from ..sampler.base import SamplerOutput
 from ..utils import as_numpy
 from ..utils.rng import RandomSeedManager
 from .dist_graph import DistGraph

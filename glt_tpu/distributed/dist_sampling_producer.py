@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import os
-from typing import Callable, List, Optional
+from typing import Callable, List
 
 import numpy as np
 
@@ -63,7 +63,6 @@ def _sampling_worker_loop(rank: int, num_workers: int,
     jax.config.update('jax_platforms', 'cpu')
   except Exception:
     pass
-  from ..loader import NodeLoader
   from ..sampler import NeighborSampler
 
   ds = dataset_builder()

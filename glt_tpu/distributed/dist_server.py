@@ -18,10 +18,10 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..channel import (
-    QueueTimeoutError, ShmChannel, pack_message, unpack_message,
+    ShmChannel, pack_message, unpack_message,
 )
 from ..channel.mp_channel import MpChannel
-from ..sampler.base import SamplingConfig, SamplingType
+from ..sampler.base import SamplingConfig
 from ..utils import as_numpy
 from .dist_context import init_server_context
 from .dist_sampling_producer import DistMpSamplingProducer, END_KEY

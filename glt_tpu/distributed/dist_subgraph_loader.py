@@ -11,7 +11,7 @@ every induced edge is discovered, so the filter is exact.
 """
 from __future__ import annotations
 
-from typing import Iterator, Optional, Sequence
+from typing import Iterator, Optional
 
 import numpy as np
 

@@ -11,7 +11,7 @@ collectives, feature all_to_all, gradient pmean all riding ICI.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Sequence
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp

@@ -17,7 +17,7 @@ import socket
 import struct
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List
 
 _HDR = struct.Struct('<Q')
 

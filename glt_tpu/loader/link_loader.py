@@ -9,7 +9,7 @@ full COO of the graph (reference link_loader.py:203-230).
 """
 from __future__ import annotations
 
-from typing import Iterator, Optional
+from typing import Optional
 
 import jax.numpy as jnp
 import numpy as np

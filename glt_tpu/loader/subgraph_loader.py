@@ -7,14 +7,13 @@ labels.
 """
 from __future__ import annotations
 
-from typing import Iterator, Optional
+from typing import Optional
 
 import jax.numpy as jnp
 import numpy as np
 
 from ..data import Dataset
 from ..sampler import NeighborSampler
-from ..utils import as_numpy
 from .node_loader import NodeLoader
 from .transform import Batch
 

@@ -10,7 +10,7 @@ when torch_geometric is importable (CPU interop only).
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 import flax.struct
 import jax

@@ -9,7 +9,6 @@ the feature matmuls stay dense on the MXU.
 """
 from __future__ import annotations
 
-from typing import Callable, Optional
 
 import flax.linen as nn
 import jax

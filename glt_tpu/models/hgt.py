@@ -8,7 +8,7 @@ over the framework's padded hetero batches.
 """
 from __future__ import annotations
 
-from typing import Dict, Sequence
+from typing import Sequence
 
 import flax.linen as nn
 import jax

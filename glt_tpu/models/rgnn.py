@@ -10,7 +10,7 @@ labels, col = d-type parent labels (message-flow orientation).
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Dict, Sequence
 
 import flax.linen as nn
 import jax

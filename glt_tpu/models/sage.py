@@ -9,7 +9,6 @@ trimming costs zero recompilation and shrinks every matmul.
 """
 from __future__ import annotations
 
-from typing import Callable, Optional, Sequence
 
 import flax.linen as nn
 import jax

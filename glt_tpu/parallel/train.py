@@ -12,7 +12,7 @@ the NCCL allreduce riding ICI. Params/optimizer state stay replicated.
 from __future__ import annotations
 
 import functools
-from typing import Callable, Optional, Sequence
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp

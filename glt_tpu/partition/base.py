@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Dict, List, Optional, Tuple, Union
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -31,7 +31,7 @@ from ..typing import (
     EdgeType, GraphPartitionData, FeaturePartitionData, NodeType, as_str,
 )
 from ..utils import as_numpy
-from .partition_book import PartitionBook, RangePartitionBook, \
+from .partition_book import PartitionBook, \
     TablePartitionBook
 
 CHUNK = 4 * 1024 * 1024

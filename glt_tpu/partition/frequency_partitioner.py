@@ -9,7 +9,7 @@ under a cache budget.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Union
+from typing import Dict, Optional, Union
 
 import numpy as np
 

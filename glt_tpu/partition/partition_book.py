@@ -8,7 +8,6 @@ bucket ids by owner before all_to_all).
 """
 from __future__ import annotations
 
-from typing import Union
 
 import numpy as np
 

@@ -25,15 +25,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..data import Dataset, Graph
+from ..data import Graph
 from ..ops.pipeline import edge_hop_offsets, multihop_sample, \
     multihop_sample_hetero
 from ..ops.sample import sample_neighbors, sample_neighbors_weighted, \
     neighbor_probs
 from ..ops.subgraph import induced_subgraph
 from ..ops.unique import (
-    dense_assign, dense_init, dense_make_tables, dense_reset,
-)
+    dense_make_tables, )
 from ..typing import EdgeType, NodeType, reverse_edge_type
 from ..utils import as_numpy
 from ..utils.rng import RandomSeedManager

@@ -2,7 +2,7 @@
 from __future__ import annotations
 
 import random
-from typing import Dict, List
+from typing import Dict
 
 import numpy as np
 

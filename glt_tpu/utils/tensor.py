@@ -6,7 +6,7 @@ and the device-side currency is jax arrays.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
