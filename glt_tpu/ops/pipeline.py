@@ -210,3 +210,32 @@ def multihop_sample_hetero(one_hops, trav, num_neighbors, num_hops,
   if with_edge:
     result['edge'] = {e: jnp.concatenate(v) for e, v in eid_d.items()}
   return result, out_tables
+
+
+def multihop_sample_many(one_hop: OneHopFn,
+                         seeds_stack: jax.Array,
+                         n_valid_stack: jax.Array,
+                         fanouts: Sequence[int],
+                         key: jax.Array,
+                         table: jax.Array,
+                         scratch: jax.Array,
+                         with_edge: bool = False):
+  """T sampling batches in ONE dispatch via lax.scan.
+
+  seeds_stack: [T, B]; n_valid_stack: [T]. Returns (stacked out dicts
+  [T, ...], table, scratch). Amortizes per-dispatch latency when host
+  round-trips dominate (e.g. small batches over an interconnect-attached
+  accelerator); the per-batch table reset keeps iterations independent,
+  so results are identical to T separate multihop_sample calls.
+  """
+  def step(carry, inp):
+    tab, scr, k = carry
+    seeds, n_valid = inp
+    k, sub = jax.random.split(k)
+    out, tab, scr = multihop_sample(one_hop, seeds, n_valid, fanouts,
+                                    sub, tab, scr, with_edge=with_edge)
+    return (tab, scr, k), out
+
+  (table, scratch, _), outs = jax.lax.scan(
+      step, (table, scratch, key), (seeds_stack, n_valid_stack))
+  return outs, table, scratch
