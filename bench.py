@@ -15,6 +15,7 @@ the reference's scale_up figure) until a measured value is available.
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 import json
+import os
 import sys
 import time
 
@@ -54,15 +55,23 @@ def main():
       indptr, indices, ids, fanout, key, seed_mask=mask)
 
   import functools
+  scan = max(int(os.environ.get('GLT_BENCH_SCAN', '4')), 1)
 
   @functools.partial(jax.jit, donate_argnums=(2, 3))
   def sample_batch(seeds, key, table, scratch):
+    if scan > 1:
+      from glt_tpu.ops.pipeline import multihop_sample_many
+      outs, table, scratch = multihop_sample_many(
+          one_hop, seeds, jnp.full(scan, BATCH, jnp.int32), FANOUT, key,
+          table, scratch)
+      return outs['num_sampled_edges'].sum(), table, scratch
     out, table, scratch = multihop_sample(
-        one_hop, seeds, jnp.asarray(BATCH), FANOUT, key, table, scratch)
+        one_hop, seeds[0], jnp.asarray(BATCH), FANOUT, key, table,
+        scratch)
     return out['num_sampled_edges'].sum(), table, scratch
 
   table, scratch = dense_make_tables(NUM_NODES)
-  seed_pool = rng.integers(0, NUM_NODES, (ITERS + WARMUP, BATCH))
+  seed_pool = rng.integers(0, NUM_NODES, (ITERS + WARMUP, scan, BATCH))
   keys = jax.random.split(jax.random.key(0), ITERS + WARMUP)
 
   edges = None
