@@ -60,3 +60,6 @@ __all__ += ['DistSubGraphLoader']
 from .dist_negative import DistRandomNegativeSampler
 
 __all__ += ['DistRandomNegativeSampler']
+from .dist_graph import dist_graph_from_partitions_multihost
+
+__all__ += ['dist_graph_from_partitions_multihost']

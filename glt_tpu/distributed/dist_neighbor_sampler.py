@@ -160,13 +160,20 @@ class DistNeighborSampler:
         check_vma=False)
 
     import functools
-    @functools.partial(jax.jit, donate_argnums=(3, 4))
-    def step(seeds, n_valid, keys, tables, scratches):
-      return fn(g.indptr, g.indices, g.edge_ids, g.edge_weights,
-                g.local_row, g.node_pb, seeds, n_valid, keys, tables,
-                scratches)
+    # graph arrays enter as ARGUMENTS (closure capture would embed them
+    # as jit constants, which cannot span processes in multi-host runs)
+    @functools.partial(jax.jit, donate_argnums=(9, 10))
+    def step(indptr, indices, edge_ids, edge_weights, local_row, node_pb,
+             seeds, n_valid, keys, tables, scratches):
+      return fn(indptr, indices, edge_ids, edge_weights, local_row,
+                node_pb, seeds, n_valid, keys, tables, scratches)
 
-    return step
+    def run(seeds, n_valid, keys, tables, scratches):
+      return step(g.indptr, g.indices, g.edge_ids, g.edge_weights,
+                  g.local_row, g.node_pb, seeds, n_valid, keys, tables,
+                  scratches)
+
+    return run
 
   def _out_keys(self):
     keys = ['node', 'node_count', 'row', 'col', 'edge_mask', 'batch',

@@ -1,0 +1,51 @@
+"""Worker script for the two-process multi-host test: each process owns
+half the mesh devices, loads only its partitions, and runs the collective
+distributed sampler. Invoked by test_multihost.py."""
+import os
+import sys
+
+
+def main():
+  rank = int(sys.argv[1])
+  root = sys.argv[2]
+  port = sys.argv[3]
+  os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=2'
+  import jax
+  jax.config.update('jax_platforms', 'cpu')
+  from glt_tpu.parallel.multihost import initialize
+  initialize(coordinator_address=f'127.0.0.1:{port}', num_processes=2,
+             process_id=rank)
+  assert jax.process_count() == 2 and jax.device_count() == 4
+
+  import numpy as np
+  from jax.sharding import Mesh
+  from glt_tpu.distributed import (
+      DistNeighborSampler, dist_graph_from_partitions_multihost,
+  )
+  mesh = Mesh(np.array(jax.devices()), ('data',))
+  dg = dist_graph_from_partitions_multihost(mesh, root)
+  s = DistNeighborSampler(dg, [2], seed=0)
+  n_nodes = 40
+  seeds = np.arange(4)[:, None] * 10       # devices seed 0,10,20,30
+  out = s.sample_from_nodes(seeds)
+  # every process verifies ITS addressable shards
+  nodes = out['node']
+  counts = out['node_count']
+  ok = 0
+  for shard in nodes.addressable_shards:
+    p = shard.index[0].start
+    local_nodes = np.asarray(shard.data)[0]
+    cnt = int(np.asarray(
+        [sh.data for sh in counts.addressable_shards
+         if sh.index[0].start == p][0])[0])
+    v = p * 10
+    got = set(local_nodes[:cnt].tolist())
+    expect = {v, (v + 1) % n_nodes, (v + 2) % n_nodes}
+    assert got == expect, f'rank {rank} shard {p}: {got} != {expect}'
+    ok += 1
+  assert ok == 2, f'rank {rank}: expected 2 local shards, saw {ok}'
+  print(f'RANK{rank}_OK', flush=True)
+
+
+if __name__ == '__main__':
+  main()
