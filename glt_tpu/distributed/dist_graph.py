@@ -72,41 +72,23 @@ class DistGraph:
     max_rows, max_edges = 1, 1
     built = []
     has_weights = all(p.weights is not None for p in parts)
-    for p, g in enumerate(parts):
-      src, dst = as_numpy(g.edge_index)
-      row, col = (src, dst) if edge_dir == 'out' else (dst, src)
-      owned = np.unique(row)
-      local_of = np.full(self.num_nodes, -1, np.int32)
-      local_of[owned] = np.arange(owned.shape[0], dtype=np.int32)
-      topo = Topology(
-          edge_index=np.stack([local_of[row], col]),
-          edge_ids=as_numpy(g.eids),
-          edge_weights=as_numpy(g.weights) if has_weights else None,
-          layout='CSR',
-          num_rows=owned.shape[0], num_cols=self.num_nodes)
+    for g in parts:
+      topo, local_of = _build_partition_block(
+          g, self.num_nodes, edge_dir, with_weights=has_weights)
       built.append((topo, local_of))
-      max_rows = max(max_rows, owned.shape[0])
+      max_rows = max(max_rows, topo.num_rows)
       max_edges = max(max_edges, topo.num_edges)
 
     max_degree = 1
     for topo, local_of in built:
-      ip = topo.indptr.astype(np.int32)
-      ip = np.concatenate(
-          [ip, np.full(max_rows + 1 - ip.shape[0], ip[-1], np.int32)])
-      ind = np.concatenate(
-          [topo.indices,
-           np.zeros(max_edges - topo.num_edges, topo.indices.dtype)])
-      eid = np.concatenate(
-          [topo.edge_ids.astype(np.int64),
-           np.full(max_edges - topo.num_edges, -1, np.int64)])
+      ip, ind, eid, w, lo = _pad_block(topo, local_of, max_rows,
+                                       max_edges)
       indptrs.append(ip)
       indices_l.append(ind)
       eids_l.append(eid)
-      locals_l.append(local_of)
+      locals_l.append(lo)
       if has_weights:
-        weights_l.append(np.concatenate(
-            [topo.edge_weights.astype(np.float32),
-             np.zeros(max_edges - topo.num_edges, np.float32)]))
+        weights_l.append(w)
       max_degree = max(max_degree, topo.max_degree)
 
     shard = NamedSharding(mesh, P(axis))
@@ -146,7 +128,8 @@ class DistGraph:
     return cls(mesh, num_nodes, parts, node_pb, edge_dir, axis)
 
 
-def _build_partition_block(g, num_nodes: int, edge_dir: str):
+def _build_partition_block(g, num_nodes: int, edge_dir: str,
+                           with_weights: bool = False):
   """One partition's padded-ready CSR pieces (pre-padding)."""
   src, dst = as_numpy(g.edge_index)
   row, col = (src, dst) if edge_dir == 'out' else (dst, src)
@@ -154,7 +137,10 @@ def _build_partition_block(g, num_nodes: int, edge_dir: str):
   local_of = np.full(num_nodes, -1, np.int32)
   local_of[owned] = np.arange(owned.shape[0], dtype=np.int32)
   topo = Topology(edge_index=np.stack([local_of[row], col]),
-                  edge_ids=as_numpy(g.eids), layout='CSR',
+                  edge_ids=as_numpy(g.eids),
+                  edge_weights=(as_numpy(g.weights) if with_weights
+                                else None),
+                  layout='CSR',
                   num_rows=owned.shape[0], num_cols=num_nodes)
   return topo, local_of
 
@@ -169,7 +155,12 @@ def _pad_block(topo, local_of, max_rows: int, max_edges: int):
   eid = np.concatenate(
       [topo.edge_ids.astype(np.int64),
        np.full(max_edges - topo.num_edges, -1, np.int64)])
-  return ip, ind, eid, local_of
+  w = None
+  if topo.edge_weights is not None:
+    w = np.concatenate(
+        [topo.edge_weights.astype(np.float32),
+         np.zeros(max_edges - topo.num_edges, np.float32)])
+  return ip, ind, eid, w, local_of
 
 
 def dist_graph_from_partitions_multihost(mesh, root_dir: str,
@@ -194,18 +185,26 @@ def dist_graph_from_partitions_multihost(mesh, root_dir: str,
                      f'edge_dir {edge_dir!r}')
   devices = mesh.devices.reshape(-1)
   n_parts = devices.shape[0]
-  assert meta['num_parts'] == n_parts
+  if meta['num_parts'] != n_parts:
+    raise ValueError(
+        f"mesh has {n_parts} devices but the partition dir holds "
+        f"{meta['num_parts']} partitions — they must match")
   mine = [i for i, d in enumerate(devices)
           if d.process_index == jax.process_index()]
 
   node_pb = None
   blocks = {}
+  parts_raw = {}
   local_max = np.zeros(3, np.int64)  # rows, edges, degree
   for p in mine:
     _, g, _, _, npb, _ = load_partition(root_dir, p)
     node_pb = npb
+    parts_raw[p] = g
+  has_weights = bool(parts_raw) and all(
+      g.weights is not None for g in parts_raw.values())
+  for p, g in parts_raw.items():
     topo, local_of = _build_partition_block(
-        g, npb.table.shape[0], edge_dir)
+        g, node_pb.table.shape[0], edge_dir, with_weights=has_weights)
     blocks[p] = (topo, local_of)
     local_max = np.maximum(
         local_max, [topo.num_rows, topo.num_edges, topo.max_degree])
@@ -222,14 +221,16 @@ def dist_graph_from_partitions_multihost(mesh, root_dir: str,
   max_rows = max(int(gmax[0]), 1)
   max_edges = max(int(gmax[1]), 1)
 
-  ips, inds, eids_l, locals_l = [], [], [], []
+  ips, inds, eids_l, locals_l, weights_l = [], [], [], [], []
   for p in mine:
     topo, local_of = blocks[p]
-    ip, ind, eid, lo = _pad_block(topo, local_of, max_rows, max_edges)
+    ip, ind, eid, w, lo = _pad_block(topo, local_of, max_rows, max_edges)
     ips.append(ip)
     inds.append(ind)
     eids_l.append(eid)
     locals_l.append(lo)
+    if has_weights:
+      weights_l.append(w)
 
   def stack_or_empty(parts, width, dtype):
     if parts:
@@ -247,7 +248,9 @@ def dist_graph_from_partitions_multihost(mesh, root_dir: str,
       mesh, stack_or_empty(inds, max_edges, np.int32), axis)
   store.edge_ids = global_from_local(
       mesh, stack_or_empty(eids_l, max_edges, np.int64), axis)
-  store.edge_weights = None
+  store.edge_weights = (global_from_local(
+      mesh, stack_or_empty(weights_l, max_edges, np.float32), axis)
+      if has_weights else None)
   store.local_row = global_from_local(
       mesh, stack_or_empty(locals_l, num_nodes, np.int32), axis)
   store.node_pb = jax.device_put(
