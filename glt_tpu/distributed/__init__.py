@@ -63,3 +63,6 @@ __all__ += ['DistRandomNegativeSampler']
 from .dist_graph import dist_graph_from_partitions_multihost
 
 __all__ += ['dist_graph_from_partitions_multihost']
+from .dist_feature import dist_feature_from_partitions_multihost
+
+__all__ += ['dist_feature_from_partitions_multihost']

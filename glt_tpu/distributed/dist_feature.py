@@ -130,3 +130,96 @@ class DistFeature:
       num_ids = max(num_ids, pb.table.shape[0])
       parts.append((np.asarray(feat.device_part), feat._id2index))
     return cls(mesh, parts, pbs, num_ids, axis=axis, dtype=dtype)
+
+
+def dist_feature_from_partitions_multihost(mesh, root_dir: str,
+                                           ntype=None, axis: str = 'data',
+                                           dtype=None) -> DistFeature:
+  """Multi-host DistFeature: each process loads ONLY its partitions'
+  feature blocks (cache-concat + PB rewrite included) and contributes
+  them via process-local assembly; padding agreed with an allgather.
+  Counterpart of dist_graph_from_partitions_multihost."""
+  import jax
+  import jax.numpy as jnp
+  from ..parallel.multihost import global_from_local
+  from ..partition import cat_feature_cache, load_meta, load_partition
+  meta = load_meta(root_dir)
+  devices = mesh.devices.reshape(-1)
+  n_parts = devices.shape[0]
+  if meta['num_parts'] != n_parts:
+    raise ValueError(
+        f"mesh has {n_parts} devices but the partition dir holds "
+        f"{meta['num_parts']} partitions")
+  mine = [i for i, d in enumerate(devices)
+          if d.process_index == jax.process_index()]
+
+  blocks = {}
+  num_ids = 0
+  feat_dim = None
+  local_max_rows = 0
+  for p in mine:
+    _, _, nfeat, _, node_pb, _ = load_partition(root_dir, p)
+    f = nfeat[ntype] if ntype is not None else nfeat
+    pb = node_pb[ntype] if ntype is not None else node_pb
+    feats, ids, id2index, pb2 = cat_feature_cache(p, f, pb)
+    blocks[p] = (feats, id2index, pb2)
+    num_ids = max(num_ids, pb2.table.shape[0])
+    feat_dim = feats.shape[1]
+    local_max_rows = max(local_max_rows, feats.shape[0])
+
+  if jax.process_count() > 1:
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(
+        jnp.asarray([local_max_rows, num_ids, feat_dim or 0]))
+    arr = np.asarray(gathered)
+    rows_max = int(arr[:, 0].max())
+    num_ids = int(arr[:, 1].max())
+    feat_dim = int(arr[:, 2].max())
+  else:
+    rows_max = max(local_max_rows, 1)
+
+  feats_l, maps_l, pbs_l = [], [], []
+  for p in mine:
+    feats, id2index, pb2 = blocks[p]
+    if dtype is not None:
+      feats = feats.astype(dtype)
+    pad = rows_max - feats.shape[0]
+    if pad:
+      feats = np.concatenate(
+          [feats, np.zeros((pad, feats.shape[1]), feats.dtype)])
+    m = np.asarray(id2index).astype(np.int32)
+    if m.shape[0] < num_ids:
+      m = np.concatenate([m, np.full(num_ids - m.shape[0], -1,
+                                     np.int32)])
+    feats_l.append(feats)
+    maps_l.append(m[:num_ids])
+    pbs_l.append(_pb_dense(pb2, num_ids))
+
+  store = DistFeature.__new__(DistFeature)
+  store.mesh = mesh
+  store.axis = axis
+  store.num_ids = num_ids
+  store.feature_dim = feat_dim
+  store.rows_max = rows_max
+  store.num_partitions = n_parts
+
+  def stack_or_empty(parts, shape_tail, dtype_):
+    if parts:
+      return np.stack(parts)
+    return np.zeros((0,) + shape_tail, dtype_)
+
+  store.array = global_from_local(
+      mesh, stack_or_empty(feats_l, (rows_max, feat_dim), np.float32),
+      axis)
+  store.id2index = global_from_local(
+      mesh, stack_or_empty(maps_l, (num_ids,), np.int32), axis)
+  store.feat_pb = global_from_local(
+      mesh, stack_or_empty(pbs_l, (num_ids,), np.int32), axis)
+  import jax as _jax
+  from jax.sharding import PartitionSpec as _P
+  store._lookup_fn = _jax.jit(_jax.shard_map(
+      lambda f, m, pb, i, v: store.lookup_local(f[0], m[0], pb[0], i, v),
+      mesh=mesh,
+      in_specs=(_P(axis), _P(axis), _P(axis), _P(axis), _P(axis)),
+      out_specs=_P(axis), check_vma=False))
+  return store

@@ -20,10 +20,12 @@ def main():
   import numpy as np
   from jax.sharding import Mesh
   from glt_tpu.distributed import (
-      DistNeighborSampler, dist_graph_from_partitions_multihost,
+      DistNeighborSampler, dist_feature_from_partitions_multihost,
+      dist_graph_from_partitions_multihost,
   )
   mesh = Mesh(np.array(jax.devices()), ('data',))
   dg = dist_graph_from_partitions_multihost(mesh, root)
+  df = dist_feature_from_partitions_multihost(mesh, root)
   s = DistNeighborSampler(dg, [2], seed=0)
   n_nodes = 40
   seeds = np.arange(4)[:, None] * 10       # devices seed 0,10,20,30
@@ -44,6 +46,15 @@ def main():
     assert got == expect, f'rank {rank} shard {p}: {got} != {expect}'
     ok += 1
   assert ok == 2, f'rank {rank}: expected 2 local shards, saw {ok}'
+  # collective feature lookup: value-encoded rows resolve exactly
+  import jax.numpy as jnp
+  ids = np.arange(4 * 8) % n_nodes
+  x = df.lookup(jnp.asarray(ids))
+  for shard in x.addressable_shards:
+    p = shard.index[0].start // 8
+    local = np.asarray(shard.data)
+    expect = ids[shard.index[0]]
+    np.testing.assert_allclose(local[:, 0], expect)
   print(f'RANK{rank}_OK', flush=True)
 
 
