@@ -117,6 +117,8 @@ class RemoteNeighborLoader:
     self.options = worker_options or RemoteDistSamplingWorkerOptions()
     ranks = self.options.server_rank
     if ranks is None:
+      assert not isinstance(input_nodes_per_server, str), (
+          'split-name seeding needs explicit server_rank in options')
       ranks = list(range(len(input_nodes_per_server)))
     if isinstance(ranks, int):
       ranks = [ranks]
@@ -131,10 +133,18 @@ class RemoteNeighborLoader:
         collect_features=collect_features, seed=seed)
     self.worker_key = (f'{self.options.worker_key}'
                        f'@client{dist_client._client_rank}')
-    for rank, seeds in zip(ranks, input_nodes_per_server):
+    if isinstance(input_nodes_per_server, str):
+      # split name: every server materializes its own seeds
+      # (RemoteNodeSplitSamplerInput parity)
+      payloads = [pack_message({'split': np.frombuffer(
+          input_nodes_per_server.encode(), np.uint8)})] * len(ranks)
+    else:
+      payloads = [pack_message({'seeds':
+                                as_numpy(s).astype(np.int64)})
+                  for s in input_nodes_per_server]
+    for rank, payload in zip(ranks, payloads):
       dist_client.request_server(
-          rank, 'create_sampling_producer', self.worker_key,
-          pack_message({'seeds': as_numpy(seeds).astype(np.int64)}),
+          rank, 'create_sampling_producer', self.worker_key, payload,
           cfg_kwargs, num_workers_per_server,
           self.options.buffer_capacity_bytes)
     self.device = device

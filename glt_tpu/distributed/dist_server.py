@@ -63,7 +63,16 @@ class DistServer:
     assert self.dataset_builder is not None, (
         'server needs a picklable dataset_builder to spawn sampling '
         'workers')
-    seeds = unpack_message(seeds_bytes)['seeds']
+    msg = unpack_message(seeds_bytes)
+    if 'split' in msg:
+      # server-side seed materialization (reference RemoteSamplerInput /
+      # RemoteNodeSplitSamplerInput, sampler/base.py:409-462): the client
+      # names a split; this server resolves it against ITS dataset
+      from ..typing import Split
+      split = Split(bytes(msg['split'].tobytes()).decode().rstrip('\0'))
+      seeds = as_numpy(self.dataset.get_split(split))
+    else:
+      seeds = msg['seeds']
     config = SamplingConfig(**config_kwargs)
     try:
       channel = ShmChannel(capacity_bytes=buffer_capacity)

@@ -15,7 +15,9 @@ def build_ring_dataset():
   import sys, os
   sys.path.insert(0, os.path.dirname(__file__))
   from fixtures import ring_dataset
-  return ring_dataset(num_nodes=40, feat_dim=4)
+  ds = ring_dataset(num_nodes=40, feat_dim=4)
+  ds.random_node_split(num_val=0.25, num_test=0.25, seed=3)
+  return ds
 
 
 
@@ -164,6 +166,23 @@ def test_server_client_mode():
     assert seen == set(range(40))
     # second epoch
     assert sum(1 for _ in loader) == 8
+
+    # split-name seeding: each server materializes its OWN train split
+    # (RemoteNodeSplitSamplerInput parity)
+    split_loader = RemoteNeighborLoader(
+        [2], 'train', batch_size=5,
+        worker_options=RemoteDistSamplingWorkerOptions(
+            server_rank=[0, 1], prefetch_size=2, worker_key='bysplit'),
+        seed=2)
+    seen2 = []
+    for b in split_loader:
+      nv = b.metadata['n_valid']
+      seen2.extend(np.asarray(b.batch)[:nv].tolist())
+    # both servers share the same dataset here, so each contributes the
+    # same 20-node train split
+    import collections
+    counts = collections.Counter(seen2)
+    assert len(counts) == 20 and set(counts.values()) == {2}
   finally:
     shutdown_client()
   for i, s in enumerate(servers):
