@@ -521,8 +521,13 @@ class DistHeteroTrainStep:
         out_specs=(P(), P(), table_specs, sp), check_vma=False)
 
     import functools
-    @functools.partial(jax.jit, donate_argnums=(2,))
-    def step(params, opt_state, tables, seeds, n_valid, keys):
+    @functools.partial(jax.jit, donate_argnums=(8,))
+    def step(params, opt_state, shards, feat_shards, labels, seeds,
+             n_valid, keys, tables):
+      return fn(params, opt_state, shards, feat_shards, labels, seeds,
+                n_valid, keys, tables)
+
+    def run(params, opt_state, tables, seeds, n_valid, keys):
       def etype_payload(e):
         d = dict(indptr=g.graphs[e].indptr, indices=g.graphs[e].indices,
                  edge_ids=g.graphs[e].edge_ids,
@@ -535,10 +540,10 @@ class DistHeteroTrainStep:
       feat_shards = {t: dict(array=feats[t].array,
                              id2index=feats[t].id2index,
                              feat_pb=feats[t].feat_pb) for t in types}
-      return fn(params, opt_state, shards, feat_shards, self.labels,
-                seeds, n_valid, keys, tables)
+      return step(params, opt_state, shards, feat_shards, self.labels,
+                  seeds, n_valid, keys, tables)
 
-    return step
+    return run
 
   def __call__(self, params, opt_state, seeds, n_valid_per_device, key):
     n_dev = self.mesh.shape[self.axis]

@@ -122,12 +122,15 @@ class DistRandomNegativeSampler:
           device_fn, mesh=self.mesh,
           in_specs=specs, out_specs=(sp, sp, sp), check_vma=False)
 
+      jit_fn = jax.jit(fn)
+
       def step(key, src_pool=None):
         n_dev = self.mesh.shape[self.axis]
         keys = jax.random.split(key, n_dev)
-        return fn(g.indptr, g.indices, g.local_row, g.node_pb, keys,
-                  src_pool)
-      return jax.jit(step)
+        # arrays passed as args: safe for multi-host global arrays
+        return jit_fn(g.indptr, g.indices, g.local_row, g.node_pb, keys,
+                      src_pool)
+      return step
     return make(False), make(True)
 
   def _fns(self, req_num: int):

@@ -131,13 +131,23 @@ class DistTrainStep:
         out_specs=(P(), P(), sp, sp, sp),
         check_vma=False)
 
-    @functools.partial(jax.jit, donate_argnums=(2, 3))
-    def step(params, opt_state, tables, scratches, seeds, n_valid, keys):
-      return fn(params, opt_state, g.indptr, g.indices, g.edge_ids,
-                g.local_row, g.node_pb, f.array, f.id2index, f.feat_pb,
-                self.labels, seeds, n_valid, keys, tables, scratches)
+    # global arrays enter as jit ARGUMENTS (closure constants cannot
+    # span processes in multi-host runs)
+    @functools.partial(jax.jit, donate_argnums=(14, 15))
+    def step(params, opt_state, indptr, indices, geids, local_row,
+             node_pb, feats, id2index, feat_pb, labels, seeds, n_valid,
+             keys, tables, scratches):
+      return fn(params, opt_state, indptr, indices, geids, local_row,
+                node_pb, feats, id2index, feat_pb, labels, seeds,
+                n_valid, keys, tables, scratches)
 
-    return step
+    def run(params, opt_state, tables, scratches, seeds, n_valid, keys):
+      return step(params, opt_state, g.indptr, g.indices, g.edge_ids,
+                  g.local_row, g.node_pb, f.array, f.id2index,
+                  f.feat_pb, self.labels, seeds, n_valid, keys, tables,
+                  scratches)
+
+    return run
 
   def __call__(self, params, opt_state, seeds, n_valid_per_device, key):
     n_dev = self.mesh.shape[self.axis]
