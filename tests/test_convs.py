@@ -67,3 +67,26 @@ def test_host_mode_graph_sampling():
   out = s.sample_from_nodes(np.array([0, 5]))
   nodes = np.asarray(out.node)[:int(out.node_count)]
   assert set(nodes.tolist()) == {0, 5, 1, 2, 6, 7}
+
+
+def test_gat_conv_multihead():
+  x = jnp.asarray(np.random.default_rng(0).normal(size=(6, 8))
+                  .astype(np.float32))
+  row = jnp.array([1, 2, 3, 4, 5])
+  col = jnp.array([0, 0, 0, 1, 1])
+  mask = jnp.array([True, True, False, True, True])
+  from glt_tpu.models import GATConv
+  conv = GATConv(4, heads=3, concat=True)
+  params = conv.init(jax.random.key(0), x, row, col, mask)
+  out = conv.apply(params, x, row, col, mask)
+  assert out.shape == (6, 12)                 # heads * features
+  # attention weights per parent sum to 1 over valid incoming edges:
+  # masked edge (3->0) contributes nothing — recompute without it
+  keep = jnp.array([0, 1, 3, 4])
+  out2 = conv.apply(params, x, row[keep], col[keep],
+                    jnp.ones(4, bool))
+  np.testing.assert_allclose(np.asarray(out), np.asarray(out2),
+                             rtol=1e-5, atol=1e-6)
+  conv_mean = GATConv(4, heads=3, concat=False)
+  p2 = conv_mean.init(jax.random.key(1), x, row, col, mask)
+  assert conv_mean.apply(p2, x, row, col, mask).shape == (6, 4)
