@@ -90,3 +90,27 @@ def test_gat_conv_multihead():
   conv_mean = GATConv(4, heads=3, concat=False)
   p2 = conv_mean.init(jax.random.key(1), x, row, col, mask)
   assert conv_mean.apply(p2, x, row, col, mask).shape == (6, 4)
+
+
+def test_trim_does_not_change_seed_outputs():
+  """Static hop-trimming drops only edges that cannot influence seed
+  representations: trimmed and untrimmed GraphSAGE agree exactly on the
+  seed rows."""
+  import sys, os
+  sys.path.insert(0, os.path.dirname(__file__))
+  from fixtures import ring_dataset
+  from glt_tpu.loader import NeighborLoader
+  from glt_tpu.models import GraphSAGE
+  ds = ring_dataset(num_nodes=40, feat_dim=8)
+  loader = NeighborLoader(ds, [2, 2, 2], input_nodes=np.arange(16),
+                          batch_size=16, seed=0)
+  b = next(iter(loader))
+  trimmed = GraphSAGE(hidden_features=16, out_features=4, num_layers=3,
+                      trim=True)
+  full = GraphSAGE(hidden_features=16, out_features=4, num_layers=3,
+                   trim=False)
+  params = trimmed.init(jax.random.key(0), b)
+  out_t = trimmed.apply(params, b)
+  out_f = full.apply(params, b)
+  np.testing.assert_allclose(np.asarray(out_t), np.asarray(out_f),
+                             rtol=1e-5, atol=1e-6)
