@@ -18,10 +18,15 @@ from .shm import QueueTimeoutError
 
 class RemoteReceivingChannel(ChannelBase):
   def __init__(self, fetch_fns: List[Callable[[], SampleMessage]],
-               prefetch_size: int = 4, capacity: int = 128):
+               prefetch_size: int = 4):
     self.fetch_fns = fetch_fns
-    self.prefetch_size = prefetch_size
-    self._out: 'queue.Queue' = queue.Queue(maxsize=capacity)
+    self.prefetch_size = max(int(prefetch_size), 1)
+    # prefetch_size bounds the per-server readahead: one puller thread
+    # per server, and the shared buffer holds at most prefetch_size
+    # messages per server before pullers block (the reference's
+    # pull-prefetch window, remote_channel.py:76-131)
+    self._out: 'queue.Queue' = queue.Queue(
+        maxsize=self.prefetch_size * max(len(fetch_fns), 1))
     self._threads: List[threading.Thread] = []
     self._live = 0
     self._lock = threading.Lock()
@@ -34,8 +39,6 @@ class RemoteReceivingChannel(ChannelBase):
       self._live = len(self.fetch_fns)
     self._threads = []
     for fn in self.fetch_fns:
-      for _ in range(self.prefetch_size):
-        pass  # concurrency is per-thread; one puller per server
       t = threading.Thread(target=self._pull_loop, args=(fn,),
                            daemon=True)
       t.start()
