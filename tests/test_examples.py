@@ -1,0 +1,45 @@
+"""Smoke tests for the examples (subprocess, CPU backend): examples are
+the workload catalog's executable documentation — they must not rot."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), 'examples')
+
+
+def _run(script, *args, timeout=150):
+  env = dict(os.environ)
+  env['GLT_PLATFORM'] = 'cpu'
+  env['PYTHONPATH'] = (os.path.dirname(_EXAMPLES) + os.pathsep
+                       + env.get('PYTHONPATH', ''))
+  out = subprocess.run(
+      [sys.executable, os.path.join(_EXAMPLES, script), *args],
+      capture_output=True, text=True, timeout=timeout, env=env,
+      cwd=_EXAMPLES)
+  assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+  return out.stdout
+
+
+def test_train_sage_example():
+  out = _run('train_sage_products.py', '--epochs', '1',
+             '--batch-size', '512', '--fanout', '5,5')
+  assert 'test acc:' in out
+
+
+def test_unsup_example():
+  out = _run('graph_sage_unsup.py', '--epochs', '1')
+  assert 'loss=' in out
+
+
+def test_seal_example():
+  out = _run('seal_link_pred.py', '--epochs', '1')
+  assert 'loss=' in out
+
+
+def test_hetero_rgnn_example():
+  out = _run(os.path.join('hetero', 'train_rgnn.py'), '--epochs', '1',
+             '--conv', 'rsage')
+  assert 'loss=' in out
