@@ -13,13 +13,28 @@ we use 2.0e8 edges/s as the assumed A100 figure (order-of-magnitude from
 the reference's scale_up figure) until a measured value is available.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Run modes
+---------
+``python bench.py``        supervisor: runs the measurement in a child
+                           process with a timeout, retrying with backoff
+                           when TPU backend init fails or wedges (the
+                           known axon-tunnel failure mode). Always emits
+                           one JSON line — on unrecoverable failure the
+                           line carries ``value: 0.0`` and an ``error``
+                           field instead of a stack trace.
+``python bench.py --run``  worker: the actual measurement (may hang if
+                           the tunnel is wedged; the supervisor guards).
+
+Env knobs: GLT_BENCH_ATTEMPTS (default 4), GLT_BENCH_TIMEOUT seconds per
+attempt (default 1500), GLT_BENCH_SCAN (batches fused per device call,
+default 4), GLT_BENCH_PLATFORM (force a jax platform, e.g. ``cpu``).
 """
 import json
 import os
+import subprocess
 import sys
 import time
-
-import numpy as np
 
 A100_ASSUMED_EDGES_PER_SEC = 2.0e8
 
@@ -30,14 +45,38 @@ FANOUT = (15, 10, 5)
 WARMUP = 3
 ITERS = 30
 
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          '.jax_cache')
 
-def main():
+
+def _emit(value, vs_baseline, **extra):
+  print(json.dumps({
+      'metric': 'sampled_edges_per_sec_per_chip',
+      'value': value,
+      'unit': 'edges/s',
+      'vs_baseline': vs_baseline,
+      **extra,
+  }))
+  sys.stdout.flush()
+
+
+def run_worker():
+  import numpy as np
   import jax
+  # The axon plugin ignores JAX_PLATFORMS; the config API is honored.
+  platform = os.environ.get('GLT_BENCH_PLATFORM')
+  if platform:
+    jax.config.update('jax_platforms', platform)
+  jax.config.update('jax_compilation_cache_dir', _CACHE_DIR)
+  jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
   import jax.numpy as jnp
   from glt_tpu.data import Topology
   from glt_tpu.ops.pipeline import multihop_sample
   from glt_tpu.ops.sample import sample_neighbors
   from glt_tpu.ops.unique import dense_make_tables
+
+  dev = jax.devices()[0]
+  print(f'# backend: {dev.platform} ({dev.device_kind})', file=sys.stderr)
 
   rng = np.random.default_rng(0)
   # out-degrees ~Poisson(25) (products' mean); in-degrees skewed via a
@@ -91,13 +130,58 @@ def main():
   total_edges = int(np.sum([int(e) for e in edge_counts]))
 
   eps = total_edges / dt
-  print(json.dumps({
-      'metric': 'sampled_edges_per_sec_per_chip',
-      'value': round(eps, 1),
-      'unit': 'edges/s',
-      'vs_baseline': round(eps / A100_ASSUMED_EDGES_PER_SEC, 4),
-  }))
+  _emit(round(eps, 1), round(eps / A100_ASSUMED_EDGES_PER_SEC, 4),
+        backend=dev.platform, scan=scan, iters=ITERS, batch=BATCH)
+
+
+def run_supervisor():
+  attempts = int(os.environ.get('GLT_BENCH_ATTEMPTS', '4'))
+  timeout = float(os.environ.get('GLT_BENCH_TIMEOUT', '1500'))
+  backoffs = [20, 60, 120]
+  last_err = 'unknown'
+  for attempt in range(attempts):
+    try:
+      proc = subprocess.run(
+          [sys.executable, os.path.abspath(__file__), '--run'],
+          capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+      last_err = f'timeout after {timeout}s (wedged backend?)'
+      print(f'# attempt {attempt + 1}/{attempts}: {last_err}',
+            file=sys.stderr)
+    else:
+      line = next((l for l in reversed(proc.stdout.splitlines())
+                   if l.startswith('{')), None)
+      if proc.returncode == 0 and line:
+        print(line)
+        return 0
+      tail = (proc.stderr or proc.stdout).strip().splitlines()[-8:]
+      last_err = (f'rc={proc.returncode}: ' + ' | '.join(tail))[:800]
+      print(f'# attempt {attempt + 1}/{attempts} failed: {last_err}',
+            file=sys.stderr)
+      # Only backend-init/tunnel failures are transient; a deterministic
+      # error (ImportError, bad config, assertion) would fail identically
+      # on retry — emit the failure line now instead of burning backoffs.
+      transient = ('initialize backend' in last_err
+                   or 'UNAVAILABLE' in last_err
+                   or 'DEADLINE' in last_err
+                   or 'RESOURCE_EXHAUSTED' in last_err
+                   or 'axon' in last_err.lower())
+      if not transient:
+        break
+    if attempt < attempts - 1:
+      delay = backoffs[min(attempt, len(backoffs) - 1)]
+      print(f'# backing off {delay}s before retry', file=sys.stderr)
+      time.sleep(delay)
+  # Unrecoverable: still emit the structured line so the driver records
+  # a parseable failure instead of a stack trace. value 0.0 + error
+  # field unambiguously marks "not measured", not "measured as 0".
+  _emit(0.0, 0.0, error=f'backend unavailable after {attempts} '
+        f'attempts: {last_err}')
+  return 0
 
 
 if __name__ == '__main__':
-  main()
+  if '--run' in sys.argv:
+    run_worker()
+  else:
+    sys.exit(run_supervisor())

@@ -5,15 +5,64 @@ Reference: graphlearn_torch/python/channel/remote_channel.py:24-131: pulls
 end-of-epoch markers. The fetcher abstraction here is any callable
 returning a SampleMessage or raising StopIteration at epoch end (the
 server-client mode wires it to DistServer.fetch_one_sampled_message).
+
+Design: one puller thread and one bounded queue *per server*, so
+``prefetch_size`` bounds each server's readahead individually (a fast
+server cannot fill a shared window and starve the others), and the
+consumer round-robins across server queues. Each ``reset()`` starts a
+new epoch: prior pullers are signalled to stop and their queues dropped,
+so a partially-consumed epoch can never leak messages into the next one.
 """
 from __future__ import annotations
 
 import queue
 import threading
-from typing import Callable, List
+import time
+from typing import Callable, List, Optional
 
 from .base import ChannelBase, SampleMessage
 from .shm import QueueTimeoutError
+
+
+class _EndOfServer:
+  """Sentinel a puller enqueues when its server's epoch is exhausted."""
+
+
+class _Puller:
+  """One server's puller thread + its bounded readahead queue. ``avail``
+  is the channel-wide condition notified on every put, so the consumer
+  wakes immediately on any server's arrival instead of polling."""
+
+  def __init__(self, fn: Callable[[], SampleMessage], bound: int,
+               avail: threading.Condition):
+    self.q: 'queue.Queue' = queue.Queue(maxsize=bound)
+    self.avail = avail
+    self.stop = threading.Event()
+    self.done = False  # consumer-side: sentinel seen
+    self.thread = threading.Thread(target=self._loop, args=(fn,),
+                                   daemon=True)
+    self.thread.start()
+
+  def _loop(self, fn) -> None:
+    while not self.stop.is_set():
+      try:
+        item = fn()
+      except StopIteration:
+        item = _EndOfServer()
+      except Exception as e:  # surface errors to the consumer
+        item = e
+      # Bounded put that stays responsive to the stop signal; on stop
+      # the item is dropped (the epoch is being abandoned anyway).
+      while not self.stop.is_set():
+        try:
+          self.q.put(item, timeout=0.1)
+          with self.avail:
+            self.avail.notify_all()
+          break
+        except queue.Full:
+          continue
+      if isinstance(item, (_EndOfServer, Exception)):
+        return
 
 
 class RemoteReceivingChannel(ChannelBase):
@@ -21,43 +70,35 @@ class RemoteReceivingChannel(ChannelBase):
                prefetch_size: int = 4):
     self.fetch_fns = fetch_fns
     self.prefetch_size = max(int(prefetch_size), 1)
-    # prefetch_size bounds the per-server readahead: one puller thread
-    # per server, and the shared buffer holds at most prefetch_size
-    # messages per server before pullers block (the reference's
-    # pull-prefetch window, remote_channel.py:76-131)
-    self._out: 'queue.Queue' = queue.Queue(
-        maxsize=self.prefetch_size * max(len(fetch_fns), 1))
-    self._threads: List[threading.Thread] = []
-    self._live = 0
-    self._lock = threading.Lock()
+    self._pullers: List[_Puller] = []
+    self._avail = threading.Condition()
+    self._rr = 0  # round-robin cursor over server queues
     self._started = False
 
   def reset(self) -> None:
-    """Start a new epoch of pulling (reference per-epoch re-arm)."""
-    self._started = True
-    with self._lock:
-      self._live = len(self.fetch_fns)
-    self._threads = []
-    for fn in self.fetch_fns:
-      t = threading.Thread(target=self._pull_loop, args=(fn,),
-                           daemon=True)
-      t.start()
-      self._threads.append(t)
+    """Start a new epoch of pulling (reference per-epoch re-arm).
 
-  def _pull_loop(self, fn) -> None:
-    while True:
-      try:
-        msg = fn()
-      except StopIteration:
-        break
-      except Exception as e:  # surface errors to the consumer
-        self._out.put(e)
-        break
-      self._out.put(msg)
-    with self._lock:
-      self._live -= 1
-      if self._live == 0:
-        self._out.put(StopIteration())
+    Any pullers from a partially-consumed previous epoch are stopped and
+    their buffered messages discarded before the new epoch begins.
+    """
+    self._stop_pullers()
+    self._started = True
+    self._rr = 0
+    self._pullers = [_Puller(fn, self.prefetch_size, self._avail)
+                     for fn in self.fetch_fns]
+
+  def _stop_pullers(self) -> None:
+    for p in self._pullers:
+      p.stop.set()
+    for p in self._pullers:
+      # Drain so a putter blocked on a full queue observes the stop.
+      while True:
+        try:
+          p.q.get_nowait()
+        except queue.Empty:
+          break
+      p.thread.join(timeout=2.0)
+    self._pullers = []
 
   def send(self, msg: SampleMessage) -> None:
     raise RuntimeError('RemoteReceivingChannel is receive-only')
@@ -65,16 +106,51 @@ class RemoteReceivingChannel(ChannelBase):
   def recv(self, timeout_ms: int = 60_000) -> SampleMessage:
     if not self._started:
       self.reset()
-    try:
-      item = self._out.get(timeout=timeout_ms / 1000)
-    except queue.Empty as e:
-      raise QueueTimeoutError('remote recv timed out') from e
-    if isinstance(item, StopIteration):
-      self._started = False
-      raise StopIteration
-    if isinstance(item, Exception):
-      raise item
-    return item
+    deadline = time.monotonic() + timeout_ms / 1000
+    while True:
+      live = [p for p in self._pullers if not p.done]
+      if not live:
+        self._started = False
+        raise StopIteration
+      # Round-robin one non-blocking pass over the live servers; if all
+      # are empty, sleep on the shared condition until ANY puller puts
+      # (no per-queue pinning, no idle polling).
+      item: Optional[object] = None
+      src: Optional[_Puller] = None
+      for off in range(len(live)):
+        p = live[(self._rr + off) % len(live)]
+        try:
+          item = p.q.get_nowait()
+          src = p
+          self._rr = (self._rr + off + 1) % len(live)
+          break
+        except queue.Empty:
+          continue
+      if item is None:
+        wait = deadline - time.monotonic()
+        if wait <= 0.0:
+          raise QueueTimeoutError('remote recv timed out')
+        with self._avail:
+          # re-check under the lock: a put may have landed between the
+          # sweep above and acquiring the condition
+          if all(p.q.empty() for p in live):
+            self._avail.wait(timeout=wait)
+        continue
+      if isinstance(item, _EndOfServer):
+        src.done = True
+        continue
+      if isinstance(item, Exception):
+        # The puller thread exits after surfacing an error; mark its
+        # server done so the epoch can still terminate if the consumer
+        # swallows the error and keeps receiving.
+        src.done = True
+        raise item
+      return item
+
+  def stop(self) -> None:
+    """Abandon the current epoch: stop pullers, drop buffered messages."""
+    self._stop_pullers()
+    self._started = False
 
   def empty(self) -> bool:
-    return self._out.empty()
+    return all(p.q.empty() for p in self._pullers)

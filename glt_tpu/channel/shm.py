@@ -22,13 +22,49 @@ class QueueTimeoutError(Exception):
   py_export_glt.cc:133-137 maps the same condition to this name)."""
 
 
-def _build_lib() -> str:
+def _src_hash() -> str:
+  import hashlib
+  h = hashlib.sha256()
+  for name in ('shm_queue.cc', 'Makefile'):
+    with open(os.path.join(_CSRC, name), 'rb') as f:
+      h.update(f.read())
+  return h.hexdigest()
+
+
+def _build_lib(force: bool = False) -> str:
+  """Build libglt_shm.so when missing or when the source changed.
+
+  Staleness is keyed on a content hash of the sources (recorded in a
+  stamp file next to the .so), not on mtimes — after a fresh clone all
+  files share checkout time, and a foreign-arch binary must not be
+  dlopen'd just because it looks newer.
+  """
+  import fcntl
   so = os.path.join(_CSRC, 'libglt_shm.so')
-  src = os.path.join(_CSRC, 'shm_queue.cc')
-  if (not os.path.exists(so)
-      or os.path.getmtime(so) < os.path.getmtime(src)):
-    subprocess.run(['make', '-C', _CSRC], check=True,
-                   capture_output=True)
+  stamp = so + '.srchash'
+  want = _src_hash()
+  # Cross-process build lock: N worker processes importing simultaneously
+  # on a fresh checkout must not run concurrent builds or dlopen a
+  # half-linked .so. The winner builds to a temp name and renames
+  # atomically; the others re-check the stamp under the lock and skip.
+  with open(os.path.join(_CSRC, '.build.lock'), 'w') as lockf:
+    fcntl.flock(lockf, fcntl.LOCK_EX)
+    have = None
+    if os.path.exists(stamp):
+      with open(stamp) as f:
+        have = f.read().strip()
+    if force or not os.path.exists(so) or have != want:
+      tmp = f'{so}.tmp.{os.getpid()}'
+      try:
+        subprocess.run(
+            ['make', '-B', '-C', _CSRC, f'SO={os.path.basename(tmp)}'],
+            check=True, capture_output=True)
+        os.replace(tmp, so)
+      finally:
+        if os.path.exists(tmp):
+          os.unlink(tmp)
+      with open(stamp, 'w') as f:
+        f.write(want)
   return so
 
 
@@ -36,7 +72,12 @@ def get_lib():
   global _LIB
   with _LIB_LOCK:
     if _LIB is None:
-      lib = ctypes.CDLL(_build_lib())
+      try:
+        lib = ctypes.CDLL(_build_lib())
+      except OSError:
+        # A stale/foreign binary slipped through (e.g. hand-copied):
+        # rebuild from source once and retry.
+        lib = ctypes.CDLL(_build_lib(force=True))
       lib.shmq_create.restype = ctypes.c_int
       lib.shmq_create.argtypes = [ctypes.c_uint64]
       lib.shmq_attach.restype = ctypes.c_void_p

@@ -121,11 +121,46 @@ int shmq_destroy(int shmid) {
   return shmctl(shmid, IPC_RMID, nullptr) == 0 ? 0 : -errno;
 }
 
+// A holder died mid-update: make the mutex usable again and, if the
+// header was left half-written, reset the ring to a sane empty state
+// (losing in-flight blocks beats leaving every future op corrupt).
+static void recover_dead_owner(QueueHeader* q) {
+  pthread_mutex_consistent(&q->mutex);
+  // head==tail with nonzero num_blocks catches a consumer killed between
+  // advancing head and decrementing num_blocks on the last block; the
+  // symmetric head!=tail with zero num_blocks catches a producer killed
+  // between advancing tail and incrementing num_blocks on an empty ring.
+  if (q->tail - q->head > q->capacity || q->num_blocks > q->capacity ||
+      (q->head == q->tail && q->num_blocks != 0) ||
+      (q->head != q->tail && q->num_blocks == 0)) {
+    q->head = 0;
+    q->tail = 0;
+    q->num_blocks = 0;
+  }
+  // The ring state just changed out from under any sleeping waiters
+  // (possibly to fully-empty/fully-free); wake them all to re-check.
+  pthread_cond_broadcast(&q->can_read);
+  pthread_cond_broadcast(&q->can_write);
+}
+
 static int lock_robust(QueueHeader* q) {
   int rc = pthread_mutex_lock(&q->mutex);
   if (rc == EOWNERDEAD) {
-    pthread_mutex_consistent(&q->mutex);
+    recover_dead_owner(q);
     rc = 0;
+  }
+  return rc;
+}
+
+// Timed wait that handles robust-mutex reacquire outcomes: returns 0 to
+// re-check the predicate (normal wake, or EOWNERDEAD recovered),
+// ETIMEDOUT, or a hard errno the caller must propagate.
+static int wait_robust(pthread_cond_t* cv, QueueHeader* q,
+                       const timespec* dl) {
+  int rc = pthread_cond_timedwait(cv, &q->mutex, dl);
+  if (rc == EOWNERDEAD) {
+    recover_dead_owner(q);
+    return 0;
   }
   return rc;
 }
@@ -158,10 +193,10 @@ int shmq_enqueue(void* handle, const void* data, uint64_t size,
       pthread_mutex_unlock(&q->mutex);
       return 0;
     }
-    int rc = pthread_cond_timedwait(&q->can_write, &q->mutex, &dl);
-    if (rc == ETIMEDOUT) {
+    int rc = wait_robust(&q->can_write, q, &dl);
+    if (rc != 0) {
       pthread_mutex_unlock(&q->mutex);
-      return -ETIMEDOUT;
+      return -rc;
     }
   }
 }
@@ -172,10 +207,10 @@ int64_t shmq_peek_size(void* handle, int timeout_ms) {
   timespec dl = deadline_after_ms(timeout_ms);
   if (lock_robust(q) != 0) return -EINVAL;
   while (q->num_blocks == 0) {
-    int rc = pthread_cond_timedwait(&q->can_read, &q->mutex, &dl);
-    if (rc == ETIMEDOUT) {
+    int rc = wait_robust(&q->can_read, q, &dl);
+    if (rc != 0) {
       pthread_mutex_unlock(&q->mutex);
-      return -ETIMEDOUT;
+      return -rc;
     }
   }
   uint64_t head = q->head;
@@ -197,10 +232,10 @@ int64_t shmq_dequeue(void* handle, void* out, uint64_t cap,
   timespec dl = deadline_after_ms(timeout_ms);
   if (lock_robust(q) != 0) return -EINVAL;
   while (q->num_blocks == 0) {
-    int rc = pthread_cond_timedwait(&q->can_read, &q->mutex, &dl);
-    if (rc == ETIMEDOUT) {
+    int rc = wait_robust(&q->can_read, q, &dl);
+    if (rc != 0) {
       pthread_mutex_unlock(&q->mutex);
-      return -ETIMEDOUT;
+      return -rc;
     }
   }
   uint64_t pos = ring_pos(q, q->head);

@@ -27,7 +27,9 @@ from ..utils import as_numpy
 from .dist_options import (
     MpDistSamplingWorkerOptions, RemoteDistSamplingWorkerOptions,
 )
-from .dist_sampling_producer import DistMpSamplingProducer, END_KEY
+from .dist_sampling_producer import (
+    DistMpSamplingProducer, END_KEY, EPOCH_KEY,
+)
 
 
 def message_to_batch(msg, config: SamplingConfig,
@@ -36,7 +38,12 @@ def message_to_batch(msg, config: SamplingConfig,
   H2D transfer point, the reference's channel.recv + .to(device))."""
   put = lambda a: (jax.device_put(jnp.asarray(a), device)
                    if a is not None else None)
-  offs = edge_hop_offsets(config.batch_size, config.num_neighbors)
+  if '#hop_offsets' in msg:
+    # producer-resolved offsets (fanout=-1 resolves worker-side to a
+    # static window the client cannot derive from config alone)
+    offs = [int(o) for o in msg['#hop_offsets']]
+  else:
+    offs = edge_hop_offsets(config.batch_size, config.num_neighbors)
   meta = {'n_valid': int(msg['n_valid'][0])} if 'n_valid' in msg else {}
   return Batch(
       x=put(msg.get('nfeats')),
@@ -85,12 +92,15 @@ class MpNeighborLoader:
     self._epoch = 0
 
   def __iter__(self):
-    self.producer.produce_all(self._epoch)
+    epoch = self._epoch
+    self.producer.produce_all(epoch)
     self._epoch += 1
     ends = 0
     while ends < self.producer.num_expected_ends:
       msg = self.channel.recv(
           timeout_ms=int(self.options.rpc_timeout * 1000))
+      if EPOCH_KEY in msg and int(msg[EPOCH_KEY][0]) != epoch:
+        continue  # leftover buffered by a partially-consumed prior epoch
       if END_KEY in msg:
         ends += 1
         continue
@@ -149,12 +159,17 @@ class RemoteNeighborLoader:
           self.options.buffer_capacity_bytes)
     self.device = device
     self._epoch = 0
+    self._epoch_active = 0
 
     def make_fetcher(rank):
       def fetch():
+        # passes the epoch this iteration belongs to; a stale puller
+        # surviving an abandoned epoch gets #STALE back (server-side
+        # guard) instead of consuming a live batch
         out = dist_client.request_server(
-            rank, 'fetch_one_sampled_message', self.worker_key)
-        if out == b'#EPOCH_END':
+            rank, 'fetch_one_sampled_message', self.worker_key,
+            self._epoch_active)
+        if out in (b'#EPOCH_END', b'#STALE'):
           raise StopIteration
         return unpack_message(out)
       return fetch
@@ -165,10 +180,16 @@ class RemoteNeighborLoader:
 
   def __iter__(self):
     from . import dist_client
+    # order matters: stop old pullers first, then advance the epoch and
+    # re-arm the servers, then re-arm the channel — so an in-flight stale
+    # fetch can only ever see old-epoch data or #STALE
+    self.channel.stop()
+    epoch = self._epoch
+    self._epoch += 1
+    self._epoch_active = epoch
     for rank in self.server_ranks:
       dist_client.request_server(rank, 'start_new_epoch_sampling',
-                                 self.worker_key, self._epoch)
-    self._epoch += 1
+                                 self.worker_key, epoch)
     self.channel.reset()
     while True:
       try:

@@ -25,6 +25,7 @@ from ..utils import as_numpy
 _SAMPLE_ALL = 'SAMPLE_ALL'
 _EXIT = 'EXIT'
 END_KEY = '#END'
+EPOCH_KEY = '#epoch'
 MP_STATUS_CHECK_INTERVAL = 5.0  # reference dist_sampling_producer.py:41-44
 
 
@@ -70,6 +71,13 @@ def _sampling_worker_loop(rank: int, num_workers: int,
       ds.graph, config.num_neighbors, with_edge=config.with_edge,
       with_weight=config.with_weight, edge_dir=config.edge_dir,
       seed=(config.seed or 0) + rank)
+  # the sampler resolves fanout=-1 to a static window; ship the resolved
+  # hop offsets with every message so the consumer's Batch slices line up
+  from ..ops.pipeline import edge_hop_offsets
+  resolved = (sampler.num_neighbors if not sampler.is_hetero
+              else config.num_neighbors)
+  hop_offs = (np.array(edge_hop_offsets(config.batch_size, resolved),
+                       np.int32) if not sampler.is_hetero else None)
   labels = ds.node_labels
   feats = ds.node_features if config.collect_features else None
 
@@ -104,8 +112,14 @@ def _sampling_worker_loop(rank: int, num_workers: int,
         x = feats[as_numpy(out.node).clip(min=0)]
       msg = flatten_sampler_output(out, y=y, x=x)
       msg['n_valid'] = np.array([n_valid], np.int32)
+      if hop_offs is not None:
+        msg['#hop_offsets'] = hop_offs
+      # every message is epoch-tagged so consumers can discard leftovers
+      # from a partially-consumed, abandoned epoch
+      msg[EPOCH_KEY] = np.array([epoch], np.int32)
       channel.send(msg)
-    channel.send({END_KEY: np.array([rank], np.int32)})
+    channel.send({END_KEY: np.array([rank], np.int32),
+                  EPOCH_KEY: np.array([epoch], np.int32)})
 
 
 class DistMpSamplingProducer:

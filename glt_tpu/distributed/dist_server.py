@@ -24,10 +24,13 @@ from ..channel.mp_channel import MpChannel
 from ..sampler.base import SamplingConfig
 from ..utils import as_numpy
 from .dist_context import init_server_context
-from .dist_sampling_producer import DistMpSamplingProducer, END_KEY
+from .dist_sampling_producer import (
+    DistMpSamplingProducer, END_KEY, EPOCH_KEY,
+)
 from .rpc import RpcServer
 
 _END = b'#EPOCH_END'
+_STALE = b'#STALE'
 
 
 class DistServer:
@@ -39,6 +42,7 @@ class DistServer:
     self._producers: Dict[str, DistMpSamplingProducer] = {}
     self._channels: Dict[str, object] = {}
     self._ends_seen: Dict[str, int] = {}
+    self._epochs: Dict[str, int] = {}
     self._exit = threading.Event()
 
   # -- control plane -----------------------------------------------------
@@ -89,19 +93,37 @@ class DistServer:
 
   def start_new_epoch_sampling(self, worker_key: str, epoch: int) -> bool:
     self._ends_seen[worker_key] = 0
+    self._epochs[worker_key] = int(epoch)
     self._producers[worker_key].produce_all(epoch)
     return True
 
-  def fetch_one_sampled_message(self, worker_key: str,
+  def fetch_one_sampled_message(self, worker_key: str, epoch=None,
                                 timeout_ms: int = 60_000) -> bytes:
     """Returns packed SampleMessage bytes or the epoch-end marker once
-    every worker has finished (reference :193-210 poll loop)."""
+    every worker has finished (reference :193-210 poll loop).
+
+    Epoch consistency: every producer message is epoch-tagged. Leftovers
+    from an abandoned epoch are discarded here, and a fetch from a stale
+    client puller (``epoch`` behind the server's current epoch) gets
+    ``#STALE`` back — any current-epoch message it raced onto is returned
+    to the buffer first, so no live batch is ever lost to a stale puller.
+    """
     producer = self._producers[worker_key]
     channel = self._channels[worker_key]
     deadline = time.time() + timeout_ms / 1000
     while True:
+      cur = self._epochs.get(worker_key, 0)
+      if epoch is not None and int(epoch) != cur:
+        return _STALE
       remaining = max(int((deadline - time.time()) * 1000), 1)
       msg = channel.recv(timeout_ms=remaining)
+      cur = self._epochs.get(worker_key, 0)
+      msg_epoch = int(msg[EPOCH_KEY][0]) if EPOCH_KEY in msg else cur
+      if msg_epoch != cur:
+        continue  # leftover from an abandoned epoch: drop
+      if epoch is not None and int(epoch) != cur:
+        channel.send(msg)  # not ours — hand back to the live epoch
+        return _STALE
       if END_KEY in msg:
         self._ends_seen[worker_key] += 1
         if self._ends_seen[worker_key] >= producer.num_expected_ends:

@@ -124,11 +124,13 @@ class GCNConv(nn.Module):
     h = nn.Dense(self.out_features, use_bias=False,
                  param_dtype=self.param_dtype, name='lin')(x)
     ones = ok.astype(h.dtype)
-    seg_out = jnp.where(ok, row, n)
     seg_in = jnp.where(ok, col, n)
-    deg_out = jax.ops.segment_sum(ones, seg_out, n + 1)[:n] + 1.0
+    # PyG GCN semantics: both endpoints are normalized by the in-degree
+    # of the self-loop-augmented graph (deg_in includes the +1 loop), and
+    # the self-loop term below uses 1/deg_in — models ported from the
+    # reference match numerically.
     deg_in = jax.ops.segment_sum(ones, seg_in, n + 1)[:n] + 1.0
-    norm = (jnp.take(deg_out, jnp.clip(row, 0, n - 1)) ** -0.5
+    norm = (jnp.take(deg_in, jnp.clip(row, 0, n - 1)) ** -0.5
             * jnp.take(deg_in, jnp.clip(col, 0, n - 1)) ** -0.5)
     msgs = jnp.take(h, jnp.clip(row, 0, n - 1), axis=0) * norm[:, None]
     agg = jax.ops.segment_sum(

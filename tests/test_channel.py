@@ -134,6 +134,74 @@ def test_remote_receiving_channel():
   assert per[0] == list(range(5)) and per[1] == list(range(5))
 
 
+def test_remote_channel_reset_discards_stale_epoch():
+  """A partially-consumed epoch must not leak messages (or pullers) into
+  the next epoch after reset()."""
+  from glt_tpu.channel import RemoteReceivingChannel
+  epoch = {'n': 0}
+  def make_fetcher(server_id, n=50):
+    state = {'i': 0, 'epoch': None}
+    def fetch():
+      if state['epoch'] != epoch['n']:
+        state['epoch'] = epoch['n']
+        state['i'] = 0
+      if state['i'] >= n:
+        raise StopIteration
+      i = state['i']; state['i'] += 1
+      return {'epoch': np.array([epoch['n']]), 'i': np.array([i])}
+    return fetch
+  ch = RemoteReceivingChannel([make_fetcher(0), make_fetcher(1)],
+                              prefetch_size=2)
+  # consume only 3 of 100 messages, then abandon the epoch. stop()
+  # before flipping the epoch so no stale in-flight fetch can consume an
+  # epoch-1 item through the shared fetcher closures.
+  for _ in range(3):
+    ch.recv(timeout_ms=10_000)
+  ch.stop()
+  epoch['n'] = 1
+  ch.reset()
+  got = []
+  while True:
+    try:
+      got.append(ch.recv(timeout_ms=10_000))
+    except StopIteration:
+      break
+  assert len(got) == 100
+  assert all(int(m['epoch'][0]) == 1 for m in got)
+  # a second clean epoch still terminates correctly
+  ch.stop()
+  epoch['n'] = 2
+  ch.reset()
+  n2 = 0
+  while True:
+    try:
+      ch.recv(timeout_ms=10_000); n2 += 1
+    except StopIteration:
+      break
+  assert n2 == 100
+
+
+def test_remote_channel_per_server_readahead_bound():
+  """One fast server must not fill the whole window: each server's
+  readahead is individually bounded by prefetch_size."""
+  import time as _time
+  from glt_tpu.channel import RemoteReceivingChannel
+  pulled = {0: 0, 1: 0}
+  def make_fetcher(server_id, delay):
+    def fetch():
+      _time.sleep(delay)
+      pulled[server_id] += 1
+      return {'sid': np.array([server_id])}
+    return fetch
+  ch = RemoteReceivingChannel([make_fetcher(0, 0.0),
+                               make_fetcher(1, 0.05)], prefetch_size=3)
+  ch.reset()
+  _time.sleep(0.5)  # let pullers run without any consumption
+  # fast server holds at most prefetch_size buffered + 1 in-flight
+  assert pulled[0] <= 4, pulled
+  ch.stop()
+
+
 def test_table_dataset_from_csv(tmp_path):
   from glt_tpu.data import TableDataset, csv_edge_reader
   p = tmp_path / 'edges.csv'

@@ -102,6 +102,31 @@ def test_mp_neighbor_loader_epoch():
     loader.shutdown()
 
 
+def test_mp_loader_abandoned_epoch_no_leak():
+  """Leftover messages from a partially-consumed epoch must be filtered
+  out of the next epoch (epoch tags, channel_loader epoch filter)."""
+  from glt_tpu.distributed import MpDistSamplingWorkerOptions, \
+      MpNeighborLoader
+  loader = MpNeighborLoader(
+      build_ring_dataset, [2], input_nodes=np.arange(40),
+      batch_size=8, collect_features=True,
+      worker_options=MpDistSamplingWorkerOptions(num_workers=2),
+      seed=0)
+  try:
+    it = iter(loader)
+    next(it)
+    next(it)  # consume 2 of 6 batches, then abandon the epoch
+    time.sleep(1.0)  # let workers finish buffering epoch-0 leftovers
+    batches = list(loader)  # epoch 1 must see exactly its own 6 batches
+    assert len(batches) == 6
+    seen = set()
+    for b in batches:
+      seen.update(np.asarray(b.batch)[:b.metadata['n_valid']].tolist())
+    assert seen == set(range(40))
+  finally:
+    loader.shutdown()
+
+
 def _server_proc(rank, port, ready, done):
   import sys, os
   sys.path.insert(0, os.path.dirname(__file__))
