@@ -165,7 +165,8 @@ class DistHeteroNeighborSampler:
   def __init__(self, graph: DistHeteroGraph, num_neighbors,
                with_edge: bool = False, with_weight: bool = False,
                max_weighted_degree: Optional[int] = None,
-               seed: Optional[int] = None):
+               seed: Optional[int] = None,
+               full_neighbor_cap: Optional[int] = None):
     self.g = graph
     self.mesh = graph.mesh
     self.axis = graph.axis
@@ -179,6 +180,18 @@ class DistHeteroNeighborSampler:
     else:
       self.num_neighbors = {k: list(num_neighbors)
                             for k in self.edge_types}
+    for e, v in self.num_neighbors.items():
+      for i, f in enumerate(v):
+        f = int(f)
+        if f == -1:  # full neighborhood: resolve to a static -window
+          cap = full_neighbor_cap or getattr(graph.graphs[e],
+                                             'max_degree', 0)
+          assert cap > 0, (f'fanout=-1 for {e} needs full_neighbor_cap '
+                           'or a store with a known max_degree')
+          f = -int(cap)
+        else:
+          assert f >= 0, f'fanout must be >= 0 or -1, got {f} for {e}'
+        v[i] = f
     hops = {len(v) for v in self.num_neighbors.values()}
     assert len(hops) == 1
     self.num_hops = hops.pop()
@@ -219,7 +232,7 @@ class DistHeteroNeighborSampler:
     for h in range(self.num_hops):
       nxt = {t: 0 for t in types}
       for etype, (row_t, col_t) in trav.items():
-        nxt[col_t] += caps[h][row_t] * self.num_neighbors[etype][h]
+        nxt[col_t] += caps[h][row_t] * abs(self.num_neighbors[etype][h])
       caps.append(nxt)
     budgets = {t: max(1, sum(c[t] for c in caps)) for t in types}
     return caps, budgets
@@ -238,8 +251,8 @@ class DistHeteroNeighborSampler:
     # frontier; inactive types produce no edges and must be excluded from
     # outputs (and from shard_map out_specs)
     etypes = [e for e in self.edge_types
-              if any(caps[h][trav[e][0]] * self.num_neighbors[e][h] > 0
-                     for h in range(self.num_hops))]
+              if any(caps[h][trav[e][0]] * abs(self.num_neighbors[e][h])
+                     > 0 for h in range(self.num_hops))]
 
     def device_core(shards, seeds, n_valid, key, tables):
       one_hops = {}

@@ -54,6 +54,7 @@ def make_dist_one_hop(graph_shards: Dict[str, jax.Array], num_nodes: int,
 
   def one_hop(ids, fanout, key, mask):
     f = ids.shape[0]
+    width = abs(fanout)  # negative = full-neighborhood hop, window |k|
     owner = jnp.take(node_pb, jnp.clip(ids, 0, num_nodes - 1),
                      mode='clip')
     owner = jnp.where(mask, owner, n_parts)
@@ -66,7 +67,12 @@ def make_dist_one_hop(graph_shards: Dict[str, jax.Array], num_nodes: int,
     # every device serves with the same folded key stream: fold by the
     # serving device so remote requests get independent randomness
     serve_key = jax.random.fold_in(key, jax.lax.axis_index(axis))
-    if with_weight and weights is not None:
+    if fanout < 0:
+      from ..ops.sample import sample_full_neighbors
+      out = sample_full_neighbors(
+          indptr, indices, jnp.clip(lrow, 0, rows_max - 1), width,
+          seed_mask=ok, edge_ids=eids)
+    elif with_weight and weights is not None:
       from ..ops.sample import sample_neighbors_weighted
       out = sample_neighbors_weighted(
           indptr, indices, weights, jnp.clip(lrow, 0, rows_max - 1),
@@ -77,9 +83,9 @@ def make_dist_one_hop(graph_shards: Dict[str, jax.Array], num_nodes: int,
       out = sample_neighbors(indptr, indices,
                              jnp.clip(lrow, 0, rows_max - 1), fanout,
                              serve_key, seed_mask=ok, edge_ids=eids)
-    resp_nbrs = all_to_all(out.nbrs.reshape(n_parts, f, fanout), axis)
-    resp_mask = all_to_all(out.mask.reshape(n_parts, f, fanout), axis)
-    resp_eids = all_to_all(out.eids.reshape(n_parts, f, fanout), axis)
+    resp_nbrs = all_to_all(out.nbrs.reshape(n_parts, f, width), axis)
+    resp_mask = all_to_all(out.mask.reshape(n_parts, f, width), axis)
+    resp_eids = all_to_all(out.eids.reshape(n_parts, f, width), axis)
     nbrs = unbucket(resp_nbrs, meta, n_parts)
     nmask = unbucket(resp_mask, meta, n_parts, invalid_value=False)
     out_eids = unbucket(resp_eids, meta, n_parts, invalid_value=-1)
@@ -100,9 +106,20 @@ class DistNeighborSampler:
   def __init__(self, dist_graph: DistGraph, num_neighbors: Sequence[int],
                with_edge: bool = False, with_weight: bool = False,
                max_weighted_degree: Optional[int] = None,
-               seed: Optional[int] = None):
+               seed: Optional[int] = None,
+               full_neighbor_cap: Optional[int] = None):
     self.g = dist_graph
-    self.num_neighbors = list(num_neighbors)
+    self.num_neighbors = []
+    for f in num_neighbors:
+      f = int(f)
+      if f == -1:  # full neighborhood: resolve to a static -window
+        cap = full_neighbor_cap or getattr(dist_graph, 'max_degree', 0)
+        assert cap > 0, ('fanout=-1 needs full_neighbor_cap or a '
+                         'DistGraph with a known max_degree')
+        f = -int(cap)
+      else:
+        assert f > 0, f'fanout must be positive or -1, got {f}'
+      self.num_neighbors.append(f)
     self.with_edge = with_edge
     self.with_weight = with_weight and dist_graph.edge_weights is not None
     self.max_weighted_degree = (max_weighted_degree

@@ -1,0 +1,79 @@
+"""DGCNN — the SEAL link-prediction model (sort-pool readout).
+
+Reference: examples/seal_link_pred.py:151-193 (stacked GCNConvs ->
+global_sort_pool(k) -> Conv1d/MaxPool1d stack -> MLP -> 1 logit). Flax
+re-design for padded static subgraphs: each enclosing subgraph is a
+fixed-capacity [N] node / [E] edge-slot graph, the forward is written for
+ONE subgraph and ``jax.vmap`` batches it — XLA then fuses the batch into
+dense MXU matmuls (no scatter-based global pooling needed: sort-pool is a
+top_k over the last GCN channel).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .conv import GCNConv
+
+
+class DGCNN(nn.Module):
+  """Forward for ONE padded subgraph: use ``jax.vmap`` over a batch.
+
+  Args:
+    hidden: GCN hidden width (reference: 32).
+    num_layers: number of hidden GCN layers (reference: 3); one extra
+      1-channel conv provides the sort key.
+    k: sort-pool size (static; reference computes the 60th-percentile
+      subgraph size — pass that in).
+  """
+  hidden: int = 32
+  num_layers: int = 3
+  k: int = 30
+  conv1d_channels: Sequence[int] = (16, 32)
+  mlp_hidden: int = 128
+
+  @nn.compact
+  def __call__(self, x, row, col, edge_mask, node_mask,
+               deterministic: bool = True):
+    # the conv1d/maxpool stack needs floor((k-2)/2+1) - 5 + 1 >= 1
+    # (the reference enforces the same with k = max(10, percentile))
+    assert self.k >= 10, 'DGCNN sort-pool k must be >= 10'
+    # GCN stack; tanh and channel-concat as the reference does
+    xs = []
+    h = x
+    for i in range(self.num_layers):
+      h = jnp.tanh(GCNConv(self.hidden, name=f'gcn{i}')(
+          h, row, col, edge_mask))
+      xs.append(h)
+    sort_key = jnp.tanh(GCNConv(1, name='gcn_key')(h, row, col, edge_mask))
+    xs.append(sort_key)
+    h = jnp.concatenate(xs, axis=-1)        # [N, hidden*L + 1]
+    h = jnp.where(node_mask[:, None], h, 0.0)
+
+    # global_sort_pool: take the k nodes with the largest sort key
+    # (invalid nodes sink to the bottom), in descending key order
+    keyv = jnp.where(node_mask, sort_key[:, 0], -jnp.inf)
+    _, top = jax.lax.top_k(keyv, self.k)    # [k]
+    pooled = jnp.take(h, top, axis=0)       # [k, F]
+    pooled = pooled * jnp.take(node_mask, top)[:, None]
+
+    # Conv1d over the flattened [k*F] sequence with kernel=stride=F reads
+    # one node per step (the reference's Conv1d(1, C, F, F))
+    feat = pooled.reshape(-1, 1)[None]      # [1, k*F, 1]
+    f_total = h.shape[-1]
+    z = nn.Conv(self.conv1d_channels[0], kernel_size=(f_total,),
+                strides=(f_total,), padding='VALID', name='conv1')(feat)
+    z = nn.relu(z)                          # [1, k, C1]
+    z = nn.max_pool(z, window_shape=(2,), strides=(2,))
+    z = nn.Conv(self.conv1d_channels[1], kernel_size=(5,), strides=(1,),
+                padding='VALID', name='conv2')(z)
+    z = nn.relu(z).reshape(-1)              # dense_dim
+
+    z = nn.Dense(self.mlp_hidden, name='mlp0')(z)
+    z = nn.relu(z)
+    z = nn.Dropout(0.5, deterministic=deterministic)(z)
+    z = nn.Dense(1, name='mlp1')(z)
+    return z[0]                             # scalar logit

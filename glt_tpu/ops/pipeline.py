@@ -20,9 +20,12 @@ OneHopFn = Callable[[jax.Array, int, jax.Array, jax.Array], NeighborOutput]
 
 
 def sample_budget(batch_size: int, fanouts: Sequence[int]) -> int:
+  # a negative fanout encodes a full-neighborhood hop with static window
+  # |k| (NeighborSampler resolves -1 to -max_degree); capacity math uses
+  # the window size either way
   budget, width = batch_size, batch_size
   for k in fanouts:
-    width *= k
+    width *= abs(k)
     budget += width
   return budget
 
@@ -30,7 +33,7 @@ def sample_budget(batch_size: int, fanouts: Sequence[int]) -> int:
 def edge_hop_offsets(batch_size: int, fanouts: Sequence[int]) -> List[int]:
   offs, cap = [0], batch_size
   for k in fanouts:
-    cap *= k
+    cap *= abs(k)
     offs.append(offs[-1] + cap)
   return offs
 
@@ -63,19 +66,20 @@ def multihop_sample(one_hop: OneHopFn,
   hop_edge_counts = []
   cap = batch_size
   for fanout in fanouts:
+    width = abs(fanout)  # negative = full-neighborhood hop, window |k|
     key, sub = jax.random.split(key)
     out = one_hop(frontier_ids, fanout, sub, frontier_mask)
     prev_count = state.count
     state, labels_flat = dense_assign(
         state, out.nbrs.reshape(-1), out.mask.reshape(-1))
-    rows_parent.append(jnp.repeat(frontier_labels, fanout))
+    rows_parent.append(jnp.repeat(frontier_labels, width))
     cols_child.append(labels_flat)
     emasks.append(out.mask.reshape(-1))
     if with_edge:
       eid_list.append(out.eids.reshape(-1))
     hop_node_counts.append(state.count - prev_count)
     hop_edge_counts.append(out.mask.sum().astype(jnp.int32))
-    cap = cap * fanout
+    cap = cap * width
     frontier_labels = prev_count + jnp.arange(cap, dtype=jnp.int32)
     frontier_mask = frontier_labels < state.count
     frontier_ids = jnp.take(state.nodes,
@@ -103,7 +107,7 @@ def hetero_edge_capacities(caps, trav, num_neighbors, num_hops):
   """Per-etype total edge-slot capacity across hops."""
   out = {}
   for e, (row_t, _) in trav.items():
-    out[e] = sum(caps[h][row_t] * num_neighbors[e][h]
+    out[e] = sum(caps[h][row_t] * abs(num_neighbors[e][h])
                  for h in range(num_hops))
   return out
 
@@ -154,15 +158,16 @@ def multihop_sample_hetero(one_hops, trav, num_neighbors, num_hops,
       k = num_neighbors[e][h]
       if caps[h][row_t] == 0 or k == 0:
         continue
+      width = abs(k)  # negative = full-neighborhood hop, window |k|
       f_ids, f_labels, f_mask = frontier[row_t]
       key, sub = jax.random.split(key)
       out = one_hops[e](f_ids, k, sub, f_mask)
       per_type_nbrs[col_t].append(
           (out.nbrs.reshape(-1), out.mask.reshape(-1)))
-      per_meta.append((e, col_t, jnp.repeat(f_labels, k),
+      per_meta.append((e, col_t, jnp.repeat(f_labels, width),
                        out.mask.reshape(-1),
                        out.eids.reshape(-1) if with_edge else None,
-                       caps[h][row_t] * k))
+                       caps[h][row_t] * width))
     prev = {t: states[t].count for t in types}
     labels_by_type = {}
     for t, chunks in per_type_nbrs.items():

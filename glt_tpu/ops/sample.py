@@ -111,6 +111,40 @@ def sample_neighbors(
   return NeighborOutput(nbrs=nbrs, mask=mask, eids=eids)
 
 
+def sample_full_neighbors(
+    indptr: jax.Array,
+    indices: jax.Array,
+    seeds: jax.Array,
+    max_degree: int,
+    seed_mask: Optional[jax.Array] = None,
+    edge_ids: Optional[jax.Array] = None,
+) -> NeighborOutput:
+  """Full-neighborhood expansion — the reference's ``fanout = -1``
+  (csrc/cpu/random_sampler.cc FullSample path; examples/seal_link_pred.py
+  uses ``[-1, -1]``). Every neighbor is returned in adjacency order
+  inside a static ``[S, max_degree]`` window; callers pass
+  ``max_degree >= graph max degree`` for exact semantics (NeighborSampler
+  resolves this automatically). Degrees above the window are truncated.
+  """
+  assert max_degree > 0
+  seeds = seeds.astype(indptr.dtype)
+  num_edges = indices.shape[0]
+  start = jnp.take(indptr, seeds, mode='clip')
+  end = jnp.take(indptr, seeds + 1, mode='clip')
+  deg = (end - start).astype(jnp.int32)
+  if seed_mask is not None:
+    deg = jnp.where(seed_mask, deg, 0)
+  deg = jnp.minimum(deg, max_degree)
+  win = jnp.arange(max_degree, dtype=jnp.int32)[None, :]   # [1, D]
+  mask = win < deg[:, None]
+  slots = jnp.clip(start[:, None] + win.astype(start.dtype),
+                   0, max(num_edges - 1, 0))
+  nbrs = jnp.take(indices, slots, mode='clip')
+  eids = jnp.take(edge_ids, slots, mode='clip') if edge_ids is not None \
+      else slots
+  return NeighborOutput(nbrs=nbrs, mask=mask, eids=eids)
+
+
 def sample_neighbors_weighted(
     indptr: jax.Array,
     indices: jax.Array,
@@ -174,11 +208,16 @@ def neighbor_probs(
   neighbors: p_nbr += p(src) * min(fanout, deg)/deg spread per neighbor.
 
   Edge-parallel formulation: for each edge (u -> v),
-  contribution(v) = p(u) * min(fanout/deg(u), 1).
+  contribution(v) = p(u) * min(fanout/deg(u), 1). A negative fanout
+  (full-neighborhood hop) touches every neighbor: rate = 1.
   """
   deg = (indptr[1:] - indptr[:-1]).astype(jnp.float32)
-  rate = jnp.where(deg > 0, jnp.minimum(fanout / jnp.maximum(deg, 1.0), 1.0),
-                   0.0)
+  if fanout < 0:
+    rate = jnp.where(deg > 0, 1.0, 0.0)
+  else:
+    rate = jnp.where(deg > 0,
+                     jnp.minimum(fanout / jnp.maximum(deg, 1.0), 1.0),
+                     0.0)
   contrib_per_src = seed_probs * rate                     # [N]
   # expand to edges: edge e has src = row(e)
   rows = jnp.searchsorted(indptr, jnp.arange(indices.shape[0],

@@ -28,8 +28,10 @@ import numpy as np
 from ..data import Graph
 from ..ops.pipeline import edge_hop_offsets, multihop_sample, \
     multihop_sample_hetero
-from ..ops.sample import sample_neighbors, sample_neighbors_weighted, \
-    neighbor_probs
+from ..ops.sample import (
+    neighbor_probs, sample_full_neighbors, sample_neighbors,
+    sample_neighbors_weighted,
+)
 from ..ops.subgraph import induced_subgraph
 from ..ops.unique import (
     dense_make_tables, )
@@ -52,15 +54,20 @@ class NeighborSampler(BaseSampler):
 
   Args:
     graph: a :class:`Graph` or Dict[EdgeType, Graph] (hetero).
-    num_neighbors: [K_1..K_h] or Dict[EdgeType, [K...]]; -1 is not
-      supported (use ``max_degree``-style subgraph ops for full
-      neighborhoods).
+    num_neighbors: [K_1..K_h] or Dict[EdgeType, [K...]]; ``-1`` means
+      full neighborhood (reference semantics, e.g. SEAL's ``[-1, -1]``):
+      every neighbor is expanded inside a static window of
+      ``full_neighbor_cap`` (default: the graph's max degree, which makes
+      the expansion exact). Frontier capacity multiplies by the window
+      size per ``-1`` hop, so use it on bounded-degree graphs or set
+      ``full_neighbor_cap`` explicitly.
     with_edge: emit edge ids (for edge features).
     with_weight: edge-weight-biased sampling (reference CPUWeightedSampler
       equivalent, device-side).
     edge_dir: 'out' (CSR expansion) or 'in' (CSC expansion).
     max_weighted_degree: static neighbor-window bound for the weighted
       path; defaults to the graph's max degree.
+    full_neighbor_cap: static neighbor-window bound for ``-1`` hops.
     seed: RNG seed; defaults to the process RandomSeedManager.
   """
 
@@ -75,6 +82,7 @@ class NeighborSampler(BaseSampler):
       replace: bool = False,
       seed: Optional[int] = None,
       max_weighted_degree: Optional[int] = None,
+      full_neighbor_cap: Optional[int] = None,
   ):
     assert edge_dir in ('out', 'in')
     self.graph = graph
@@ -85,6 +93,7 @@ class NeighborSampler(BaseSampler):
     self.replace = replace
     self.device = device
     self.max_weighted_degree = max_weighted_degree
+    self.full_neighbor_cap = full_neighbor_cap
     if seed is not None:
       self._base_key = jax.random.key(seed)
     else:
@@ -107,13 +116,17 @@ class NeighborSampler(BaseSampler):
       else:
         self.num_neighbors = {
             k: list(num_neighbors) for k in self.edge_types}
+      self.num_neighbors = {
+          k: [self._resolve_fanout(f, graph[k]) for f in v]
+          for k, v in self.num_neighbors.items()}
       hops = {len(v) for v in self.num_neighbors.values()}
       assert len(hops) == 1, 'all edge types need the same hop count'
       self.num_hops = hops.pop()
       self._node_counts = self._infer_node_counts()
     else:
       self.edge_types = None
-      self.num_neighbors = list(num_neighbors)
+      self.num_neighbors = [self._resolve_fanout(f, graph)
+                            for f in num_neighbors]
       self.num_hops = len(self.num_neighbors)
       self._node_counts = None
 
@@ -121,6 +134,18 @@ class NeighborSampler(BaseSampler):
     self._tables = {}   # key: ntype or '' -> (table, scratch)
 
   # -- helpers -----------------------------------------------------------
+
+  def _resolve_fanout(self, fanout: int, g: Graph) -> int:
+    """Map the user-facing fanout to the internal encoding: positive =
+    sample ``fanout``; ``-1`` resolves to ``-window`` where ``window`` is
+    the static full-neighborhood cap (pipeline capacity math uses |k|)."""
+    fanout = int(fanout)
+    if fanout == -1:
+      cap = self.full_neighbor_cap or g.topo.max_degree
+      assert cap > 0, 'graph has no edges; fanout=-1 is meaningless'
+      return -int(cap)
+    assert fanout > 0, f'fanout must be positive or -1, got {fanout}'
+    return fanout
 
   def _infer_node_counts(self) -> Dict[NodeType, int]:
     counts: Dict[NodeType, int] = {}
@@ -144,8 +169,12 @@ class NeighborSampler(BaseSampler):
     return self._tables[ntype]
 
   def _one_hop(self, g: Graph, frontier, fanout, key, mask):
-    """Dispatch uniform vs weighted one-hop sampling on graph ``g``."""
+    """Dispatch full/uniform/weighted one-hop sampling on graph ``g``."""
     eids = g.edge_ids if self.with_edge else None
+    if fanout < 0:  # full neighborhood inside a |fanout|-wide window
+      return sample_full_neighbors(
+          g.indptr, g.indices, frontier, -fanout, seed_mask=mask,
+          edge_ids=eids)
     if self.with_weight and g.edge_weights is not None:
       max_deg = self.max_weighted_degree or g.topo.max_degree
       max_deg = max(max_deg, fanout)
@@ -226,7 +255,7 @@ class NeighborSampler(BaseSampler):
       nxt = {t: 0 for t in self._node_counts}
       for etype, (row_t, col_t) in trav.items():
         k = self.num_neighbors[etype][h]
-        nxt[col_t] += caps[h][row_t] * k
+        nxt[col_t] += caps[h][row_t] * abs(k)
       caps.append(nxt)
     budgets = {t: max(1, sum(c[t] for c in caps))
                for t in self._node_counts}

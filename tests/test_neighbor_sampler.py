@@ -176,3 +176,90 @@ def test_hetero_sample_prob(hetero):
   # user 3 -> items {6, 7} (deg 2 <= fanout 2 -> prob 1)
   assert it[6] == 1.0 and it[7] == 1.0
   assert it[[0, 1, 2, 3]].sum() == 0.0
+
+
+# -- fanout = -1 (full neighborhood) ------------------------------------
+
+def _random_var_degree_dataset(n=25, seed=42):
+  from glt_tpu.data import Dataset
+  rng = np.random.default_rng(seed)
+  edges = set()
+  for v in range(n):
+    for w in rng.choice(n, int(rng.integers(0, 7)), replace=False):
+      if int(w) != v:
+        edges.add((v, int(w)))
+  edges = sorted(edges)
+  rows = np.array([e[0] for e in edges], np.int64)
+  cols = np.array([e[1] for e in edges], np.int64)
+  ds = Dataset(edge_dir='out')
+  ds.init_graph(edge_index=np.stack([rows, cols]), num_nodes=n)
+  adj = {v: sorted(w for (x, w) in edges if x == v) for v in range(n)}
+  return ds, adj
+
+
+def test_full_neighborhood_two_hop_exact():
+  """NeighborSampler([-1, -1]) must reproduce the dense 2-hop expansion
+  exactly (reference fanout=-1 semantics, seal_link_pred.py:45-59)."""
+  ds, adj = _random_var_degree_dataset()
+  s = NeighborSampler(ds.get_graph(), [-1, -1], seed=0)
+  seeds = [3, 17]
+  out = s.sample_from_nodes(np.array(seeds))
+
+  node = np.asarray(out.node)
+  em = np.asarray(out.edge_mask)
+  child = node[np.asarray(out.row)]
+  parent = node[np.asarray(out.col)]
+  offs = out.edge_hop_offsets
+
+  # hop 1: exactly every out-edge of every seed
+  got1 = sorted((int(parent[i]), int(child[i]))
+                for i in range(offs[0], offs[1]) if em[i])
+  want1 = sorted((v, w) for v in seeds for w in adj[v])
+  assert got1 == want1
+
+  # hop 2: every out-edge of every node first seen in hop 1
+  seen = list(seeds)
+  lvl1_new = []
+  for i in range(offs[0], offs[1]):
+    if em[i] and int(child[i]) not in seen:
+      seen.append(int(child[i]))
+      lvl1_new.append(int(child[i]))
+  got2 = sorted((int(parent[i]), int(child[i]))
+                for i in range(offs[1], offs[2]) if em[i])
+  want2 = sorted((v, w) for v in lvl1_new for w in adj[v])
+  assert got2 == want2
+
+  # node set is the exact 2-hop closure
+  closure = set(seeds)
+  closure |= {w for v in seeds for w in adj[v]}
+  closure |= {w for v in list(closure) for w in adj[v]}
+  assert set(node[:int(out.node_count)].tolist()) == closure
+
+
+def test_full_neighborhood_cap_truncates():
+  ds, adj = _random_var_degree_dataset()
+  s = NeighborSampler(ds.get_graph(), [-1], seed=0, full_neighbor_cap=2)
+  out = s.sample_from_nodes(np.array([3]))
+  em = np.asarray(out.edge_mask)
+  # window of 2: at most 2 neighbors survive, in adjacency order
+  got = sorted(np.asarray(out.node)[np.asarray(out.row)[em]].tolist())
+  assert got == sorted(adj[3][:2])
+
+
+def test_full_neighborhood_mixed_with_sampled_hop():
+  """[-1, K] mixes a full hop with a sampled hop."""
+  ds, adj = _random_var_degree_dataset()
+  s = NeighborSampler(ds.get_graph(), [-1, 1], seed=5)
+  out = s.sample_from_nodes(np.array([3]))
+  offs = out.edge_hop_offsets
+  em = np.asarray(out.edge_mask)
+  node = np.asarray(out.node)
+  got1 = sorted(node[np.asarray(out.row)[offs[0]:offs[1]]]
+                [em[offs[0]:offs[1]]].tolist())
+  assert got1 == adj[3]
+  # hop 2: each new frontier node contributes at most 1 sampled edge
+  parents2 = node[np.asarray(out.col)[offs[1]:offs[2]]][em[offs[1]:offs[2]]]
+  cnt = {}
+  for p in parents2.tolist():
+    cnt[p] = cnt.get(p, 0) + 1
+  assert all(c == 1 for c in cnt.values())
