@@ -53,6 +53,7 @@ def message_to_batch(msg, config: SamplingConfig,
       node=put(msg['node']),
       node_count=put(msg['node_count'][0]),
       edge=put(msg.get('eids')),
+      edge_attr=put(msg.get('efeats')),
       num_sampled_nodes=put(msg.get('num_sampled_nodes')),
       num_sampled_edges=put(msg.get('num_sampled_edges')),
       metadata=meta,

@@ -124,6 +124,15 @@ class DistDataset(Dataset):
       return self.node_pb[ntype]
     return self.node_pb
 
+  def get_edge_feat_pb(self, etype=None):
+    """Edge-feature routing book (reference dist_dataset.py exposes the
+    same beside the node book; used by the edge DistFeature)."""
+    pb = self.edge_feat_pb if self.edge_feat_pb is not None \
+        else self.edge_pb
+    if isinstance(pb, dict) and etype is not None:
+      return pb[etype]
+    return pb
+
 
 class DistTableDataset(DistDataset):
   """Distributed table loading (reference

@@ -116,16 +116,30 @@ class DistFeature:
 
   @classmethod
   def from_dist_datasets(cls, mesh: Mesh, datasets, ntype=None,
-                         axis: str = 'data', dtype=None):
+                         axis: str = 'data', dtype=None,
+                         kind: str = 'node'):
     """Single-host simulation: build from every partition's DistDataset
-    (features must be fully device-resident)."""
+    (features must be fully device-resident).
+
+    ``kind='edge'`` builds the *edge*-feature store (id space = global
+    edge ids, routed by the edge-feature partition book) — the TPU
+    counterpart of the reference's edge DistFeature
+    (dist_feature.py:69-452 with group='edge_feat'); ``ntype`` then
+    selects the edge type for hetero datasets.
+    """
+    assert kind in ('node', 'edge')
     parts, pbs = [], []
     num_ids = 0
     for ds in datasets:
-      feat = (ds.node_features[ntype] if ntype is not None
-              else ds.node_features)
+      if kind == 'edge':
+        feat = (ds.edge_features[ntype] if ntype is not None
+                else ds.edge_features)
+        pb = ds.get_edge_feat_pb(ntype)
+      else:
+        feat = (ds.node_features[ntype] if ntype is not None
+                else ds.node_features)
+        pb = ds.get_node_feat_pb(ntype)
       feat.lazy_init()
-      pb = ds.get_node_feat_pb(ntype)
       pbs.append(pb)
       num_ids = max(num_ids, pb.table.shape[0])
       parts.append((np.asarray(feat.device_part), feat._id2index))

@@ -401,18 +401,30 @@ class DistHeteroTrainStep:
                features: Dict[NodeType, object],   # DistFeature per type
                model, tx, labels: Dict[NodeType, np.ndarray],
                num_neighbors, batch_size_per_device: int,
-               seed_type: NodeType, seed: Optional[int] = None):
+               seed_type: NodeType, seed: Optional[int] = None,
+               edge_features: Optional[Dict[EdgeType, object]] = None,
+               with_weight: bool = False,
+               max_weighted_degree: Optional[int] = None):
+    """``edge_features`` maps *traversal* edge types to edge-id-space
+    DistFeatures; when given, sampling emits eids and the batch carries
+    ``edge_attr_dict`` (reference efeat collate,
+    dist_neighbor_sampler.py:689-807). ``with_weight`` enables the
+    weighted per-etype collective one-hop (reference
+    neighbor_sampler.py:96-144 hetero weighted loops)."""
     import optax
     self.g = graph
     self.features = features
+    self.edge_features = edge_features or {}
     self.model = model
     self.tx = tx
     self.seed_type = seed_type
     self.bs = int(batch_size_per_device)
     self.mesh = graph.mesh
     self.axis = graph.axis
-    self.sampler = DistHeteroNeighborSampler(graph, num_neighbors,
-                                             seed=seed)
+    self.sampler = DistHeteroNeighborSampler(
+        graph, num_neighbors, with_edge=bool(self.edge_features),
+        with_weight=with_weight, max_weighted_degree=max_weighted_degree,
+        seed=seed)
     self.labels = {t: jax.device_put(as_numpy(v),
                                      NamedSharding(self.mesh, P()))
                    for t, v in labels.items()}
@@ -433,16 +445,23 @@ class DistHeteroTrainStep:
     from ..ops.pipeline import hetero_edge_capacities
     ecaps = hetero_edge_capacities(caps, trav, self.sampler.num_neighbors,
                                    self.sampler.num_hops)
-    row_d, col_d, mask_d = {}, {}, {}
+    row_d, col_d, mask_d, eattr_d, eid_d = {}, {}, {}, {}, {}
     for e in trav:
       ecap = max(ecaps[e], 1)
       k = self._final_key(e)
       row_d[k] = jnp.zeros((ecap,), jnp.int32)
       col_d[k] = jnp.zeros((ecap,), jnp.int32)
       mask_d[k] = jnp.zeros((ecap,), bool)
+      if self.sampler.with_edge:
+        eid_d[k] = jnp.zeros((ecap,), jnp.int32)
+      if e in self.edge_features:
+        eattr_d[k] = jnp.zeros((ecap,
+                                self.edge_features[e].feature_dim))
     return HeteroBatch(
         x_dict=x_dict, row_dict=row_d, col_dict=col_d,
         edge_mask_dict=mask_d,
+        edge_attr_dict=eattr_d or None,
+        edge_dict=eid_d or None,
         node_dict={t: jnp.zeros((budgets[t],), jnp.int32)
                    for t in self.features},
         node_count_dict={t: jnp.zeros((), jnp.int32)
@@ -464,9 +483,17 @@ class DistHeteroTrainStep:
         bs, seed_type)
     types = list(g.node_counts)
     feats = self.features
+    unknown = set(self.edge_features) - set(self.sampler.edge_types)
+    assert not unknown, (
+        f'edge_features keys {sorted(map(str, unknown))} are not '
+        'traversal edge types; valid keys: '
+        f'{sorted(map(str, self.sampler.edge_types))} '
+        '(pass the traversal type, not the reversed output key)')
+    # inactive etypes (no frontier ever reaches them) sample no edges
+    efeats = {e: v for e, v in self.edge_features.items() if e in etypes}
 
-    def device_step(params, opt_state, shards, feat_shards, labels,
-                    seeds, n_valid, key, tables):
+    def device_step(params, opt_state, shards, feat_shards, efeat_shards,
+                    labels, seeds, n_valid, key, tables):
       def unpack(sh):
         d = dict(indptr=sh['indptr'][0], indices=sh['indices'][0],
                  edge_ids=sh['edge_ids'][0],
@@ -491,11 +518,23 @@ class DistHeteroTrainStep:
       y = jnp.take(labels[seed_type],
                    jnp.maximum(out['batch'], 0)[:bs])
       fk = self._final_key
+      edge_attr_dict = None
+      if efeats:
+        edge_attr_dict = {}
+        for e in efeats:
+          fs = efeat_shards[e]
+          edge_attr_dict[fk(e)] = efeats[e].lookup_local(
+              fs['array'][0], fs['id2index'][0], fs['feat_pb'][0],
+              jnp.maximum(out['edge'][e], 0), out['edge_mask'][e],
+              axis_name=axis)
       batch = HeteroBatch(
           x_dict=x_dict,
           row_dict={fk(e): out['col'][e] for e in etypes},
           col_dict={fk(e): out['row'][e] for e in etypes},
           edge_mask_dict={fk(e): out['edge_mask'][e] for e in etypes},
+          edge_attr_dict=edge_attr_dict,
+          edge_dict=({fk(e): out['edge'][e] for e in etypes}
+                     if 'edge' in out else None),
           node_dict=out['node'], node_count_dict=out['node_count'],
           y_dict={seed_type: y}, input_type=seed_type, batch_size=bs)
 
@@ -524,21 +563,23 @@ class DistHeteroTrainStep:
     shard_specs = {e: etype_spec2(e) for e in etypes}
     feat_specs = {t: dict(array=sp, id2index=sp, feat_pb=sp)
                   for t in types}
+    efeat_specs = {e: dict(array=sp, id2index=sp, feat_pb=sp)
+                   for e in efeats}
     table_specs = {t: (sp, sp) for t in types}
     label_specs = {t: P() for t in self.labels}
 
     fn = jax.shard_map(
         device_step, mesh=self.mesh,
-        in_specs=(P(), P(), shard_specs, feat_specs, label_specs, sp, sp,
-                  sp, table_specs),
+        in_specs=(P(), P(), shard_specs, feat_specs, efeat_specs,
+                  label_specs, sp, sp, sp, table_specs),
         out_specs=(P(), P(), table_specs, sp), check_vma=False)
 
     import functools
-    @functools.partial(jax.jit, donate_argnums=(8,))
-    def step(params, opt_state, shards, feat_shards, labels, seeds,
-             n_valid, keys, tables):
-      return fn(params, opt_state, shards, feat_shards, labels, seeds,
-                n_valid, keys, tables)
+    @functools.partial(jax.jit, donate_argnums=(9,))
+    def step(params, opt_state, shards, feat_shards, efeat_shards,
+             labels, seeds, n_valid, keys, tables):
+      return fn(params, opt_state, shards, feat_shards, efeat_shards,
+                labels, seeds, n_valid, keys, tables)
 
     def run(params, opt_state, tables, seeds, n_valid, keys):
       def etype_payload(e):
@@ -553,8 +594,11 @@ class DistHeteroTrainStep:
       feat_shards = {t: dict(array=feats[t].array,
                              id2index=feats[t].id2index,
                              feat_pb=feats[t].feat_pb) for t in types}
-      return step(params, opt_state, shards, feat_shards, self.labels,
-                  seeds, n_valid, keys, tables)
+      efeat_shards = {e: dict(array=efeats[e].array,
+                              id2index=efeats[e].id2index,
+                              feat_pb=efeats[e].feat_pb) for e in efeats}
+      return step(params, opt_state, shards, feat_shards, efeat_shards,
+                  self.labels, seeds, n_valid, keys, tables)
 
     return run
 

@@ -48,10 +48,13 @@ class DistNeighborLoader:
                drop_last: bool = False,
                with_edge: bool = False,
                seed: Optional[int] = None,
-               rng: Optional[np.random.Generator] = None):
-    self.sampler = DistNeighborSampler(dist_graph, num_neighbors,
-                                       with_edge=with_edge, seed=seed)
+               rng: Optional[np.random.Generator] = None,
+               edge_feature: Optional[DistFeature] = None):
+    self.sampler = DistNeighborSampler(
+        dist_graph, num_neighbors,
+        with_edge=with_edge or edge_feature is not None, seed=seed)
     self.feature = dist_feature
+    self.edge_feature = edge_feature
     self.labels = as_numpy(labels)
     self.n_dev = dist_graph.mesh.shape[dist_graph.axis]
     if isinstance(input_nodes, (list, tuple)):
@@ -94,6 +97,12 @@ class DistNeighborLoader:
                  < out['node_count'][:, None]).reshape(-1)
         x = self.feature.lookup(jnp.maximum(node, 0), valid)
         out['x'] = x.reshape(out['node'].shape + (-1,))
+      if self.edge_feature is not None and 'edge' in out:
+        import jax.numpy as jnp
+        eids = out['edge'].reshape(-1)
+        ea = self.edge_feature.lookup(jnp.maximum(eids, 0),
+                                      out['edge_mask'].reshape(-1))
+        out['edge_attr'] = ea.reshape(out['edge'].shape + (-1,))
       if self.labels is not None:
         out['y'] = self.labels[np.maximum(np.asarray(out['batch']), 0)]
       out['n_valid'] = n_valid

@@ -29,9 +29,10 @@ EPOCH_KEY = '#epoch'
 MP_STATUS_CHECK_INTERVAL = 5.0  # reference dist_sampling_producer.py:41-44
 
 
-def flatten_sampler_output(out, y=None, x=None) -> SampleMessage:
+def flatten_sampler_output(out, y=None, x=None,
+                           edge_attr=None) -> SampleMessage:
   """SamplerOutput -> flat SampleMessage (the reference _colloate_fn keys,
-  dist_neighbor_sampler.py:689-807)."""
+  dist_neighbor_sampler.py:689-807, including the ``efeats`` collate)."""
   msg = {
       'node': as_numpy(out.node),
       'node_count': as_numpy(out.node_count).reshape(1),
@@ -48,6 +49,8 @@ def flatten_sampler_output(out, y=None, x=None) -> SampleMessage:
     msg['nlabels'] = as_numpy(y)
   if x is not None:
     msg['nfeats'] = as_numpy(x)
+  if edge_attr is not None:
+    msg['efeats'] = as_numpy(edge_attr)
   return msg
 
 
@@ -80,6 +83,8 @@ def _sampling_worker_loop(rank: int, num_workers: int,
                        np.int32) if not sampler.is_hetero else None)
   labels = ds.node_labels
   feats = ds.node_features if config.collect_features else None
+  efeats = (ds.edge_features
+            if config.with_edge and config.collect_features else None)
 
   while True:
     try:
@@ -110,7 +115,10 @@ def _sampling_worker_loop(rank: int, num_workers: int,
       x = None
       if feats is not None:
         x = feats[as_numpy(out.node).clip(min=0)]
-      msg = flatten_sampler_output(out, y=y, x=x)
+      ea = None
+      if efeats is not None and out.edge is not None:
+        ea = efeats[as_numpy(out.edge).clip(min=0)]
+      msg = flatten_sampler_output(out, y=y, x=x, edge_attr=ea)
       msg['n_valid'] = np.array([n_valid], np.int32)
       if hop_offs is not None:
         msg['#hop_offsets'] = hop_offs

@@ -11,7 +11,7 @@ collectives, feature all_to_all, gradient pmean all riding ICI.
 from __future__ import annotations
 
 import functools
-from typing import Sequence
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -36,13 +36,19 @@ class DistTrainStep:
     tx: optax optimizer.
     labels: [N] global labels (replicated; label lookups are cheap).
     fanouts, batch_size_per_device: sampling shape.
+    edge_feature: optional edge-feature DistFeature (id space = global
+      edge ids); when given, sampling emits eids and the batch carries
+      ``edge_attr`` gathered through the same all_to_all path — the
+      reference's efeat collate (dist_neighbor_sampler.py:689-807).
   """
 
   def __init__(self, dist_graph: DistGraph, dist_feature: DistFeature,
                model, tx, labels, fanouts: Sequence[int],
-               batch_size_per_device: int):
+               batch_size_per_device: int,
+               edge_feature: Optional[DistFeature] = None):
     self.g = dist_graph
     self.f = dist_feature
+    self.ef = edge_feature
     self.model = model
     self.tx = tx
     self.fanouts = list(fanouts)
@@ -72,6 +78,10 @@ class DistTrainStep:
         node=jnp.zeros((budget,), jnp.int32),
         node_count=jnp.zeros((), jnp.int32),
         y=jnp.zeros((self.bs,), jnp.int32),
+        edge=(jnp.zeros((ecap,), jnp.int32)
+              if self.ef is not None else None),
+        edge_attr=(jnp.zeros((ecap, self.ef.feature_dim))
+                   if self.ef is not None else None),
         batch_size=self.bs,
         edge_hop_offsets=tuple(edge_hop_offsets(self.bs, self.fanouts)))
 
@@ -80,15 +90,17 @@ class DistTrainStep:
     return jax.device_put(params, NamedSharding(self.mesh, P()))
 
   def _build(self):
-    g, f = self.g, self.f
+    g, f, ef = self.g, self.f, self.ef
     model, tx, axis, bs = self.model, self.tx, self.axis, self.bs
     fanouts = self.fanouts
     offs = tuple(edge_hop_offsets(bs, fanouts))
     n_parts = g.num_partitions
+    with_edge = ef is not None
 
     def device_step(params, opt_state, indptr, indices, geids, local_row,
                     node_pb, feats, id2index, feat_pb, labels, seeds,
-                    n_valid, key, table, scratch):
+                    n_valid, key, table, scratch, *eargs):
+      efeats, eid2index, efeat_pb = eargs if with_edge else (None,) * 3
       shards = dict(indptr=indptr[0], indices=indices[0],
                     edge_ids=geids[0], local_row=local_row[0],
                     node_pb=node_pb)
@@ -97,15 +109,24 @@ class DistTrainStep:
       my_key = jax.random.fold_in(key[0], jax.lax.axis_index(axis))
       out, table_o, scratch_o = multihop_sample(
           one_hop, seeds, n_valid[0], fanouts, my_key, table[0],
-          scratch[0])
+          scratch[0], with_edge=with_edge)
       node_valid = jnp.arange(out['node'].shape[0]) < out['node_count']
       x = f.lookup_local(feats[0], id2index[0], feat_pb[0],
                          jnp.maximum(out['node'], 0), node_valid,
                          axis_name=axis)
+      edge_attr = None
+      if with_edge:
+        # the efeat collate of the reference loop, as one more
+        # all_to_all over the sampled global edge ids
+        edge_attr = ef.lookup_local(
+            efeats[0], eid2index[0], efeat_pb[0],
+            jnp.maximum(out['edge'], 0), out['edge_mask'],
+            axis_name=axis)
       y = jnp.take(labels, jnp.maximum(out['batch'], 0)[:bs])
       batch = Batch(x=x, row=out['row'], col=out['col'],
                     edge_mask=out['edge_mask'], node=out['node'],
                     node_count=out['node_count'], y=y, batch_size=bs,
+                    edge=out.get('edge'), edge_attr=edge_attr,
                     edge_hop_offsets=offs)
 
       def loss_fn(p):
@@ -124,10 +145,11 @@ class DistTrainStep:
       return params, opt_state, table_o[None], scratch_o[None], loss[None]
 
     sp = P(self.axis)
+    extra = (sp, sp, sp) if with_edge else ()
     fn = jax.shard_map(
         device_step, mesh=self.mesh,
         in_specs=(P(), P(), sp, sp, sp, sp, P(), sp, sp, sp, P(), sp, sp,
-                  sp, sp, sp),
+                  sp, sp, sp) + extra,
         out_specs=(P(), P(), sp, sp, sp),
         check_vma=False)
 
@@ -136,16 +158,17 @@ class DistTrainStep:
     @functools.partial(jax.jit, donate_argnums=(14, 15))
     def step(params, opt_state, indptr, indices, geids, local_row,
              node_pb, feats, id2index, feat_pb, labels, seeds, n_valid,
-             keys, tables, scratches):
+             keys, tables, scratches, *eargs):
       return fn(params, opt_state, indptr, indices, geids, local_row,
                 node_pb, feats, id2index, feat_pb, labels, seeds,
-                n_valid, keys, tables, scratches)
+                n_valid, keys, tables, scratches, *eargs)
 
     def run(params, opt_state, tables, scratches, seeds, n_valid, keys):
+      eargs = ((ef.array, ef.id2index, ef.feat_pb) if with_edge else ())
       return step(params, opt_state, g.indptr, g.indices, g.edge_ids,
                   g.local_row, g.node_pb, f.array, f.id2index,
                   f.feat_pb, self.labels, seeds, n_valid, keys, tables,
-                  scratches)
+                  scratches, *eargs)
 
     return run
 

@@ -35,8 +35,8 @@ def test_unsup_example():
 
 
 def test_seal_example():
-  out = _run('seal_link_pred.py', '--epochs', '1')
-  assert 'loss=' in out
+  out = _run('seal_link_pred.py', '--epochs', '1', '--nodes', '120')
+  assert 'Loss:' in out and 'Test:' in out
 
 
 def test_hetero_rgnn_example():

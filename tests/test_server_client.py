@@ -102,6 +102,30 @@ def test_mp_neighbor_loader_epoch():
     loader.shutdown()
 
 
+def test_mp_loader_edge_features_value_encoded():
+  """Edge features ride the channel path: the ring fixture value-encodes
+  edge feature row e as [e]*4, so batch.edge_attr must equal the eids."""
+  from glt_tpu.distributed import MpDistSamplingWorkerOptions, \
+      MpNeighborLoader
+  loader = MpNeighborLoader(
+      build_ring_dataset, [2], input_nodes=np.arange(40),
+      batch_size=8, collect_features=True, with_edge=True,
+      worker_options=MpDistSamplingWorkerOptions(num_workers=2),
+      seed=0)
+  try:
+    saw_edges = 0
+    for b in loader:
+      assert b.edge is not None and b.edge_attr is not None
+      em = np.asarray(b.edge_mask)
+      eids = np.asarray(b.edge)[em]
+      ea = np.asarray(b.edge_attr)[em]
+      np.testing.assert_allclose(ea[:, 0], eids)
+      saw_edges += em.sum()
+    assert saw_edges > 0
+  finally:
+    loader.shutdown()
+
+
 def test_mp_loader_abandoned_epoch_no_leak():
   """Leftover messages from a partially-consumed epoch must be filtered
   out of the next epoch (epoch tags, channel_loader epoch filter)."""
