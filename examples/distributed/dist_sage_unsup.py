@@ -1,0 +1,133 @@
+"""Distributed unsupervised GraphSAGE — the reference's
+examples/distributed/dist_sage_unsup workload: per-rank edge seed pools,
+binary negative sampling, endpoint neighborhood expansion through the
+distributed engine, dot-product BCE on edge_label_index pairs.
+
+TPU formulation: DistLinkNeighborLoader drives the SPMD collective
+sampler + DistFeature lookup; the train step is one shard_map program
+(per-device forward + pmean'd grads — the DDP allreduce as an XLA
+collective).
+"""
+import argparse
+import os
+import sys
+import tempfile
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), '..'))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), '..', '..'))
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--num-devices', type=int, default=8)
+  ap.add_argument('--nodes', type=int, default=4_000)
+  ap.add_argument('--epochs', type=int, default=2)
+  ap.add_argument('--batch-size', type=int, default=32,
+                  help='positive edges per device per step')
+  ap.add_argument('--fanout', default='8,4')
+  ap.add_argument('--cpu-mesh', action=argparse.BooleanOptionalAction,
+                  default=True)
+  args = ap.parse_args()
+
+  if args.cpu_mesh:
+    os.environ['XLA_FLAGS'] = (
+        os.environ.get('XLA_FLAGS', '') +
+        f' --xla_force_host_platform_device_count={args.num_devices}')
+  import jax
+  if args.cpu_mesh:
+    jax.config.update('jax_platforms', 'cpu')
+  import jax.numpy as jnp
+  import numpy as np
+  import optax
+  from jax.sharding import NamedSharding, PartitionSpec as P
+
+  from glt_tpu.distributed import (
+      DistFeature, DistGraph, DistLinkNeighborLoader, DistDataset,
+  )
+  from glt_tpu.loader.transform import Batch
+  from glt_tpu.models import GraphSAGE
+  from glt_tpu.ops.pipeline import edge_hop_offsets
+  from glt_tpu.parallel import make_mesh
+  from glt_tpu.partition import RandomPartitioner
+  from glt_tpu.sampler import NegativeSampling
+
+  n = args.nodes
+  rng = np.random.default_rng(0)
+  src = np.concatenate([np.arange(n), rng.integers(0, n, n * 4)])
+  dst = np.concatenate([(np.arange(n) + 1) % n, rng.integers(0, n, n * 4)])
+  feats = rng.normal(size=(n, 64)).astype(np.float32)
+
+  root = tempfile.mkdtemp(prefix='unsup_parts_')
+  RandomPartitioner(root, num_parts=args.num_devices, num_nodes=n,
+                    edge_index=np.stack([src, dst]),
+                    node_feat=feats).partition()
+  mesh = make_mesh(args.num_devices)
+  dg = DistGraph.from_dataset_partitions(mesh, root)
+  dss = [DistDataset().load(root, p) for p in range(args.num_devices)]
+  df = DistFeature.from_dist_datasets(mesh, dss)
+
+  # per-device positive-edge pools = the edges whose src the device owns
+  pb = np.asarray(dg.node_pb)
+  pools = []
+  for p in range(args.num_devices):
+    m = pb[src] == p
+    pools.append(np.stack([src[m], dst[m]]))
+
+  fanout = [int(x) for x in args.fanout.split(',')]
+  loader = DistLinkNeighborLoader(
+      dg, fanout, pools, dist_feature=df,
+      neg_sampling=NegativeSampling('binary', amount=1),
+      batch_size=args.batch_size, shuffle=True, seed=0)
+
+  spd = loader.seeds_per_device
+  offs = tuple(edge_hop_offsets(spd, fanout))
+  model = GraphSAGE(hidden_features=128, out_features=64, num_layers=2)
+  tx = optax.adam(3e-3)
+  axis = dg.axis
+
+  def device_step(params, opt_state, x, row, col, emask, eli, lab):
+    batch = Batch(x=x[0], row=row[0], col=col[0], edge_mask=emask[0],
+                  node=jnp.zeros((x.shape[1],), jnp.int32),
+                  node_count=jnp.zeros((), jnp.int32),
+                  batch_size=spd, edge_hop_offsets=offs)
+
+    def loss_fn(p):
+      emb = model.apply(p, batch, method=GraphSAGE.embed)
+      logit = (jnp.take(emb, eli[0, 0], axis=0)
+               * jnp.take(emb, eli[0, 1], axis=0)).sum(-1)
+      return optax.sigmoid_binary_cross_entropy(logit, lab[0]).mean()
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    grads = jax.lax.pmean(grads, axis)
+    loss = jax.lax.pmean(loss, axis)
+    ups, opt_state = tx.update(grads, opt_state, params)
+    return optax.apply_updates(params, ups), opt_state, loss[None]
+
+  sp = P(axis)
+  step = jax.jit(jax.shard_map(
+      device_step, mesh=mesh,
+      in_specs=(P(), P(), sp, sp, sp, sp, sp, sp),
+      out_specs=(P(), P(), sp), check_vma=False))
+
+  b0 = next(iter(loader))
+  dummy = Batch(x=jnp.asarray(b0['x'][0]), row=jnp.asarray(b0['row'][0]),
+                col=jnp.asarray(b0['col'][0]),
+                edge_mask=jnp.asarray(b0['edge_mask'][0]),
+                node=jnp.zeros((b0['x'].shape[1],), jnp.int32),
+                node_count=jnp.zeros((), jnp.int32), batch_size=spd,
+                edge_hop_offsets=offs)
+  params = jax.device_put(model.init(jax.random.key(0), dummy),
+                          NamedSharding(mesh, P()))
+  opt = jax.device_put(tx.init(params), NamedSharding(mesh, P()))
+
+  shard = NamedSharding(mesh, P(axis))
+  for epoch in range(args.epochs):
+    for b in loader:
+      args_dev = [jax.device_put(jnp.asarray(b[k]), shard)
+                  for k in ('x', 'row', 'col', 'edge_mask',
+                            'edge_label_index', 'edge_label')]
+      params, opt, loss = step(params, opt, *args_dev)
+    print(f'epoch {epoch}: loss={float(np.asarray(loss)[0]):.4f}')
+
+
+if __name__ == '__main__':
+  main()

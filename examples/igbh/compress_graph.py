@@ -1,0 +1,145 @@
+"""Offline COO -> compressed (CSC/CSR) conversion for IGBH-layout data.
+
+TPU equivalent of the reference's examples/igbh/compress_graph.py
+(:106-107 saves indptr/indices per edge type after layout conversion)
+plus its ``float2half`` feature compression (dataset.py): here features
+compress to bfloat16 (the TPU-native half type).
+
+Input layout (the IGBH on-disk convention):
+  <root>/processed/<src>__<rel>__<dst>/edge_index.npy     [2, E] COO
+  <root>/processed/<ntype>/node_feat.npy                  [N, D]
+  <root>/processed/paper/node_label.npy                   [N]
+
+Output:
+  <root>/<layout>/<src>__<rel>__<dst>/compressed.npz  (indptr, indices,
+  edge_ids) + <root>/<layout>/<ntype>/node_feat_bf16.npy when --bf16.
+
+This environment has no dataset downloads, so ``--synthesize N`` first
+materializes a synthetic MAG-shaped graph at that paper count in the
+same on-disk layout — the tool chain (synthesize -> compress ->
+split_seeds -> dist_train_rgnn) then mirrors the reference's
+(download -> compress -> split_seeds -> dist_train_rgnn).
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                '..', '..'))
+
+
+def synthesize(root: str, num_papers: int, seed: int = 0,
+               feat_dim: int = 128, num_classes: int = 16) -> None:
+  """Materialize a synthetic MAG-shaped IGBH-layout dataset on disk:
+  paper-cites-paper (~10/paper), author-writes-paper (~3/paper),
+  author-affiliated-institute."""
+  rng = np.random.default_rng(seed)
+  num_authors = max(num_papers // 2, 4)
+  num_inst = max(num_papers // 50, 4)
+  proc = os.path.join(root, 'processed')
+  rels = {
+      ('paper', 'cites', 'paper'): (
+          rng.integers(0, num_papers, num_papers * 10),
+          rng.integers(0, num_papers, num_papers * 10)),
+      ('author', 'writes', 'paper'): (
+          rng.integers(0, num_authors, num_papers * 3),
+          rng.integers(0, num_papers, num_papers * 3)),
+      ('author', 'affiliated', 'institute'): (
+          rng.integers(0, num_authors, num_authors),
+          rng.integers(0, num_inst, num_authors)),
+  }
+  for (s, r, d), (src, dst) in rels.items():
+    ed = os.path.join(proc, f'{s}__{r}__{d}')
+    os.makedirs(ed, exist_ok=True)
+    np.save(os.path.join(ed, 'edge_index.npy'),
+            np.stack([src, dst]).astype(np.int64))
+  counts = {'paper': num_papers, 'author': num_authors,
+            'institute': num_inst}
+  pf = rng.normal(size=(num_papers, feat_dim)).astype(np.float32)
+  w = rng.normal(size=(feat_dim, num_classes)).astype(np.float32)
+  for t, n in counts.items():
+    nd = os.path.join(proc, t)
+    os.makedirs(nd, exist_ok=True)
+    feat = pf if t == 'paper' else \
+        rng.normal(size=(n, feat_dim)).astype(np.float32)
+    np.save(os.path.join(nd, 'node_feat.npy'), feat)
+  labels = np.argmax(pf @ w, 1).astype(np.int32)
+  np.save(os.path.join(proc, 'paper', 'node_label.npy'), labels)
+  with open(os.path.join(proc, 'meta.txt'), 'w') as f:
+    for t, n in counts.items():
+      f.write(f'{t} {n}\n')
+
+
+def load_meta(root: str) -> dict:
+  counts = {}
+  with open(os.path.join(root, 'processed', 'meta.txt')) as f:
+    for line in f:
+      t, n = line.split()
+      counts[t] = int(n)
+  return counts
+
+
+def compress(root: str, layout: str = 'CSC', bf16: bool = False,
+             topology: bool = True) -> None:
+  """COO -> compressed per-etype topology (+ optional bf16 features).
+
+  ``topology=False`` runs only the feature compression — callers that
+  re-partition from COO anyway (dist_train_rgnn's synthesize path) skip
+  the topology pass they would not read.
+  """
+  from glt_tpu.data import Topology
+  proc = os.path.join(root, 'processed')
+  out_root = os.path.join(root, layout.lower())
+  counts = load_meta(root)
+  for name in (sorted(os.listdir(proc)) if topology else ()):
+    path = os.path.join(proc, name, 'edge_index.npy')
+    if not os.path.exists(path):
+      continue
+    s, r, d = name.split('__')
+    ei = np.load(path)
+    n_rows, n_cols = ((d, s) if layout.upper() == 'CSC' else (s, d))
+    topo = Topology(edge_index=ei, layout=layout.upper(),
+                    num_rows=counts[n_rows], num_cols=counts[n_cols])
+    od = os.path.join(out_root, name)
+    os.makedirs(od, exist_ok=True)
+    np.savez(os.path.join(od, 'compressed.npz'),
+             indptr=topo.indptr, indices=topo.indices,
+             edge_ids=topo.edge_ids)
+    print(f'{name}: {ei.shape[1]} edges -> {layout} '
+          f'(indptr {topo.indptr.shape[0]})')
+  if bf16:
+    import ml_dtypes
+    for t in counts:
+      fp = os.path.join(proc, t, 'node_feat.npy')
+      if os.path.exists(fp):
+        feat = np.load(fp).astype(ml_dtypes.bfloat16)
+        od = os.path.join(out_root, t)
+        os.makedirs(od, exist_ok=True)
+        # .npy cannot express the bfloat16 dtype; store the bit pattern
+        # (readers view it back, see dist_train_rgnn.load_igbh_root)
+        np.save(os.path.join(od, 'node_feat_bf16.npy'),
+                feat.view(np.uint16))
+        print(f'{t}: features -> bf16 {feat.shape}')
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--path', required=True,
+                  help='dataset root (IGBH on-disk layout)')
+  ap.add_argument('--layout', default='CSC', choices=['CSC', 'CSR'])
+  ap.add_argument('--bf16', action='store_true',
+                  help='also compress features to bfloat16')
+  ap.add_argument('--synthesize', type=int, default=0, metavar='PAPERS',
+                  help='first materialize a synthetic IGBH-layout '
+                       'dataset at this paper count (no downloads here)')
+  ap.add_argument('--seed', type=int, default=0)
+  args = ap.parse_args()
+  if args.synthesize:
+    synthesize(args.path, args.synthesize, seed=args.seed)
+  compress(args.path, layout=args.layout, bf16=args.bf16)
+
+
+if __name__ == '__main__':
+  main()

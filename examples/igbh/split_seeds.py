@@ -1,0 +1,36 @@
+"""Deterministic train/val seed split for IGBH-layout datasets.
+
+TPU equivalent of the reference's examples/igbh/split_seeds.py: a seeded
+permutation of the labeled papers, 60% train / ``validation_frac`` val,
+saved beside the processed data as train_idx.npy / val_idx.npy.
+"""
+import argparse
+import os
+
+import numpy as np
+
+
+def split_seeds(path: str, random_seed: int = 42,
+                validation_frac: float = 0.01,
+                train_frac: float = 0.6) -> None:
+  proc = os.path.join(path, 'processed')
+  labels = np.load(os.path.join(proc, 'paper', 'node_label.npy'))
+  n = labels.shape[0]
+  rng = np.random.default_rng(random_seed)
+  perm = rng.permutation(n)
+  n_train = int(n * train_frac)
+  n_val = int(n * validation_frac)
+  np.save(os.path.join(proc, 'train_idx.npy'), perm[:n_train])
+  np.save(os.path.join(proc, 'val_idx.npy'),
+          perm[n_train:n_train + n_val])
+  print(f'{n} labeled papers -> {n_train} train / {n_val} val')
+
+
+if __name__ == '__main__':
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--path', required=True)
+  ap.add_argument('--random_seed', type=int, default=42)
+  ap.add_argument('--validation_frac', type=float, default=0.01)
+  ap.add_argument('--train_frac', type=float, default=0.6)
+  a = ap.parse_args()
+  split_seeds(a.path, a.random_seed, a.validation_frac, a.train_frac)

@@ -430,6 +430,7 @@ class DistHeteroTrainStep:
                    for t, v in labels.items()}
     self._optax = optax
     self._step_fn = self._build()
+    self._eval_fn = None  # built lazily on first eval_step call
 
   def _final_key(self, e):
     return reverse_edge_type(e) if self.g.edge_dir == 'out' else e
@@ -473,11 +474,13 @@ class DistHeteroTrainStep:
     params = self.model.init(key, self.dummy_batch())
     return jax.device_put(params, NamedSharding(self.mesh, P()))
 
-  def _build(self):
+  def _assembly(self):
+    """Shared device-batch assembly for the train and eval programs:
+    returns (device_batch, specs, payloads, table_specs) where
+    ``device_batch(...)`` runs sampling + feature/efeat collate inside
+    shard_map and yields (batch, y, out_tables)."""
     from ..loader.transform import HeteroBatch
-    optax = self._optax
-    g, model, tx, axis, bs = (self.g, self.model, self.tx, self.axis,
-                              self.bs)
+    g, axis, bs = self.g, self.axis, self.bs
     seed_type = self.seed_type
     device_core, caps, budgets, etypes = self.sampler._make_device_core(
         bs, seed_type)
@@ -492,8 +495,8 @@ class DistHeteroTrainStep:
     # inactive etypes (no frontier ever reaches them) sample no edges
     efeats = {e: v for e, v in self.edge_features.items() if e in etypes}
 
-    def device_step(params, opt_state, shards, feat_shards, efeat_shards,
-                    labels, seeds, n_valid, key, tables):
+    def device_batch(shards, feat_shards, efeat_shards, labels, seeds,
+                     n_valid, key, tables):
       def unpack(sh):
         d = dict(indptr=sh['indptr'][0], indices=sh['indices'][0],
                  edge_ids=sh['edge_ids'][0],
@@ -537,6 +540,55 @@ class DistHeteroTrainStep:
                      if 'edge' in out else None),
           node_dict=out['node'], node_count_dict=out['node_count'],
           y_dict={seed_type: y}, input_type=seed_type, batch_size=bs)
+      out_tables = {t: (tb[None], sc[None])
+                    for t, (tb, sc) in out_tables.items()}
+      return batch, y, out_tables
+
+    sp = P(self.axis)
+    def etype_spec(e):
+      d = dict(indptr=sp, indices=sp, edge_ids=sp, local_row=sp,
+               node_pb=P())
+      if g.graphs[e].edge_weights is not None:
+        d['edge_weights'] = sp
+      return d
+    specs = dict(
+        shards={e: etype_spec(e) for e in etypes},
+        feats={t: dict(array=sp, id2index=sp, feat_pb=sp)
+               for t in types},
+        efeats={e: dict(array=sp, id2index=sp, feat_pb=sp)
+                for e in efeats},
+        tables={t: (sp, sp) for t in types},
+        labels={t: P() for t in self.labels},
+        sp=sp)
+
+    def payloads():
+      def etype_payload(e):
+        d = dict(indptr=g.graphs[e].indptr, indices=g.graphs[e].indices,
+                 edge_ids=g.graphs[e].edge_ids,
+                 local_row=g.graphs[e].local_row,
+                 node_pb=g.graphs[e].node_pb)
+        if g.graphs[e].edge_weights is not None:
+          d['edge_weights'] = g.graphs[e].edge_weights
+        return d
+      return (
+          {e: etype_payload(e) for e in etypes},
+          {t: dict(array=feats[t].array, id2index=feats[t].id2index,
+                   feat_pb=feats[t].feat_pb) for t in types},
+          {e: dict(array=efeats[e].array, id2index=efeats[e].id2index,
+                   feat_pb=efeats[e].feat_pb) for e in efeats})
+
+    return device_batch, specs, payloads
+
+  def _build(self):
+    optax = self._optax
+    model, tx, axis, bs = self.model, self.tx, self.axis, self.bs
+    device_batch, specs, payloads = self._assembly()
+
+    def device_step(params, opt_state, shards, feat_shards, efeat_shards,
+                    labels, seeds, n_valid, key, tables):
+      batch, y, out_tables = device_batch(
+          shards, feat_shards, efeat_shards, labels, seeds, n_valid,
+          key, tables)
 
       def loss_fn(p):
         logits = model.apply(p, batch)
@@ -549,30 +601,15 @@ class DistHeteroTrainStep:
       loss = jax.lax.pmean(loss, axis)
       updates, opt_state = tx.update(grads, opt_state, params)
       params = optax.apply_updates(params, updates)
-      out_tables = {t: (tb[None], sc[None])
-                    for t, (tb, sc) in out_tables.items()}
       return params, opt_state, out_tables, loss[None]
 
-    sp = P(self.axis)
-    def etype_spec2(e):
-      d = dict(indptr=sp, indices=sp, edge_ids=sp, local_row=sp,
-               node_pb=P())
-      if g.graphs[e].edge_weights is not None:
-        d['edge_weights'] = sp
-      return d
-    shard_specs = {e: etype_spec2(e) for e in etypes}
-    feat_specs = {t: dict(array=sp, id2index=sp, feat_pb=sp)
-                  for t in types}
-    efeat_specs = {e: dict(array=sp, id2index=sp, feat_pb=sp)
-                   for e in efeats}
-    table_specs = {t: (sp, sp) for t in types}
-    label_specs = {t: P() for t in self.labels}
-
+    sp = specs['sp']
     fn = jax.shard_map(
         device_step, mesh=self.mesh,
-        in_specs=(P(), P(), shard_specs, feat_specs, efeat_specs,
-                  label_specs, sp, sp, sp, table_specs),
-        out_specs=(P(), P(), table_specs, sp), check_vma=False)
+        in_specs=(P(), P(), specs['shards'], specs['feats'],
+                  specs['efeats'], specs['labels'], sp, sp, sp,
+                  specs['tables']),
+        out_specs=(P(), P(), specs['tables'], sp), check_vma=False)
 
     import functools
     @functools.partial(jax.jit, donate_argnums=(9,))
@@ -582,21 +619,7 @@ class DistHeteroTrainStep:
                 labels, seeds, n_valid, keys, tables)
 
     def run(params, opt_state, tables, seeds, n_valid, keys):
-      def etype_payload(e):
-        d = dict(indptr=g.graphs[e].indptr, indices=g.graphs[e].indices,
-                 edge_ids=g.graphs[e].edge_ids,
-                 local_row=g.graphs[e].local_row,
-                 node_pb=g.graphs[e].node_pb)
-        if g.graphs[e].edge_weights is not None:
-          d['edge_weights'] = g.graphs[e].edge_weights
-        return d
-      shards = {e: etype_payload(e) for e in etypes}
-      feat_shards = {t: dict(array=feats[t].array,
-                             id2index=feats[t].id2index,
-                             feat_pb=feats[t].feat_pb) for t in types}
-      efeat_shards = {e: dict(array=efeats[e].array,
-                              id2index=efeats[e].id2index,
-                              feat_pb=efeats[e].feat_pb) for e in efeats}
+      shards, feat_shards, efeat_shards = payloads()
       return step(params, opt_state, shards, feat_shards, efeat_shards,
                   self.labels, seeds, n_valid, keys, tables)
 
@@ -613,3 +636,59 @@ class DistHeteroTrainStep:
     params, opt_state, self.sampler.tables, loss = self._step_fn(
         params, opt_state, self.sampler.tables, seeds, nv, keys)
     return params, opt_state, loss
+
+  # -- evaluation (reference dist_train_rgnn.py evaluate loop) -----------
+
+  def _build_eval(self):
+    """Forward-only SPMD step returning (correct, total) mesh-summed."""
+    model, axis, bs = self.model, self.axis, self.bs
+    device_batch, specs, payloads = self._assembly()
+
+    def device_eval(params, shards, feat_shards, efeat_shards, labels,
+                    seeds, n_valid, key, tables):
+      batch, y, out_tables = device_batch(
+          shards, feat_shards, efeat_shards, labels, seeds, n_valid,
+          key, tables)
+      logits = model.apply(params, batch)
+      mask = jnp.arange(bs) < n_valid[0]
+      correct = jnp.where(mask, jnp.argmax(logits, -1) == y, False)
+      correct = jax.lax.psum(correct.sum(), axis)
+      total = jax.lax.psum(mask.sum(), axis)
+      return out_tables, correct[None], total[None]
+
+    sp = specs['sp']
+    fn = jax.shard_map(
+        device_eval, mesh=self.mesh,
+        in_specs=(P(), specs['shards'], specs['feats'], specs['efeats'],
+                  specs['labels'], sp, sp, sp, specs['tables']),
+        out_specs=(specs['tables'], sp, sp), check_vma=False)
+
+    import functools
+    @functools.partial(jax.jit, donate_argnums=(8,))
+    def jfn(params, shards, feat_shards, efeat_shards, labels, seeds,
+            n_valid, keys, tables):
+      return fn(params, shards, feat_shards, efeat_shards, labels,
+                seeds, n_valid, keys, tables)
+
+    def run(params, tables, seeds, n_valid, keys):
+      shards, feat_shards, efeat_shards = payloads()
+      return jfn(params, shards, feat_shards, efeat_shards, self.labels,
+                 seeds, n_valid, keys, tables)
+
+    return run
+
+  def eval_step(self, params, seeds, n_valid_per_device, key):
+    """Forward-only accuracy over one seed batch; returns
+    (num_correct, num_total) summed over the mesh."""
+    if self._eval_fn is None:
+      self._eval_fn = self._build_eval()
+    n_dev = self.mesh.shape[self.axis]
+    shard = NamedSharding(self.mesh, P(self.axis))
+    seeds = jax.device_put(
+        jnp.asarray(np.asarray(seeds).reshape(-1), jnp.int32), shard)
+    nv = jax.device_put(jnp.asarray(n_valid_per_device, jnp.int32),
+                        shard)
+    keys = jax.random.split(key, n_dev)
+    self.sampler.tables, correct, total = self._eval_fn(
+        params, self.sampler.tables, seeds, nv, keys)
+    return int(np.asarray(correct)[0]), int(np.asarray(total)[0])

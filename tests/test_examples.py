@@ -43,3 +43,39 @@ def test_hetero_rgnn_example():
   out = _run(os.path.join('hetero', 'train_rgnn.py'), '--epochs', '1',
              '--conv', 'rsage')
   assert 'loss=' in out
+
+
+def test_igbh_pipeline_tools(tmp_path):
+  """compress_graph --synthesize -> split_seeds: the preprocessing
+  chain produces loadable compressed topology + seed splits."""
+  import numpy as np
+  root = str(tmp_path / 'igbh')
+  out = _run(os.path.join('igbh', 'compress_graph.py'),
+             '--path', root, '--synthesize', '500', '--bf16')
+  assert 'edges -> CSC' in out and 'bf16' in out
+  out = _run(os.path.join('igbh', 'split_seeds.py'), '--path', root)
+  assert 'train' in out
+  ti = np.load(os.path.join(root, 'processed', 'train_idx.npy'))
+  vi = np.load(os.path.join(root, 'processed', 'val_idx.npy'))
+  assert ti.shape[0] == 300 and vi.shape[0] == 5
+  assert len(set(ti.tolist()) & set(vi.tolist())) == 0
+  comp = np.load(os.path.join(
+      root, 'csc', 'paper__cites__paper', 'compressed.npz'))
+  assert comp['indptr'].shape[0] == 501
+  assert comp['indices'].shape[0] == 5000
+
+
+def test_igbh_dist_train_example():
+  out = _run(os.path.join('igbh', 'dist_train_rgnn.py'),
+             '--papers', '1500', '--epochs', '1',
+             '--steps-per-epoch', '2', '--batch-size', '8',
+             '--val-batches', '1', '--hidden', '16', '--conv', 'rsage',
+             timeout=400)
+  assert 'val_acc=' in out and ':::MLLOG' in out and 'done' in out
+
+
+def test_dist_sage_unsup_example():
+  out = _run(os.path.join('distributed', 'dist_sage_unsup.py'),
+             '--nodes', '600', '--epochs', '1', '--batch-size', '8',
+             timeout=400)
+  assert 'loss=' in out
