@@ -9,6 +9,11 @@ import argparse
 import json
 import time
 
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # repo root -> glt_tpu
+
 import numpy as np
 
 

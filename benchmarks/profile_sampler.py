@@ -1,0 +1,182 @@
+"""Sampling-pipeline breakdown: where does a multihop batch spend time?
+
+PERF_PLAN step 3: times the composed pipeline against its constituent
+stages at bench.py shapes (2.45M nodes / 62M edges, batch 1024,
+[15,10,5]) and, with ``--trace DIR``, also captures a ``jax.profiler``
+trace of 10 steady-state iterations for op-level inspection.
+
+Stages timed (each as its own jitted program, steady state):
+  one_hop_h{i}    sample_neighbors at hop i's frontier width
+  assign_h{i}     dense_assign (dedup/relabel) at hop i's output width
+  composed        the full multihop_sample program
+  composed_scan   multihop_sample_many with GLT_BENCH_SCAN batches fused
+
+Prints one JSON line with per-stage ms and the top-3 costliest stages.
+``GLT_BENCH_PLATFORM=cpu`` forces the CPU backend.
+"""
+import argparse
+import functools
+import json
+import os
+import time
+
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # repo root -> glt_tpu
+
+import numpy as np
+
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), '.jax_cache')
+
+NUM_NODES = 2_450_000
+NUM_EDGES = 62_000_000
+BATCH = 1024
+FANOUT = (15, 10, 5)
+
+
+def _time_fn(fn, args, iters=20, warmup=3, donate_state=False):
+  """Steady-state seconds/call for a jitted fn; fn returns arrays."""
+  import jax
+  out = None
+  state = args
+  for _ in range(warmup):
+    out = fn(*state)
+    if donate_state:
+      state = (state[0], state[1], out[1], out[2])
+  jax.block_until_ready(out)
+  t0 = time.time()
+  for _ in range(iters):
+    out = fn(*state)
+    if donate_state:
+      state = (state[0], state[1], out[1], out[2])
+  jax.block_until_ready(out)
+  return (time.time() - t0) / iters
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--trace', default=None,
+                  help='also dump a jax.profiler trace to this dir')
+  ap.add_argument('--iters', type=int, default=20)
+  args = ap.parse_args()
+
+  import jax
+  if os.environ.get('GLT_BENCH_PLATFORM'):
+    jax.config.update('jax_platforms', os.environ['GLT_BENCH_PLATFORM'])
+  jax.config.update('jax_compilation_cache_dir', _CACHE_DIR)
+  jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
+  import jax.numpy as jnp
+  from glt_tpu.data import Topology
+  from glt_tpu.ops.pipeline import multihop_sample, multihop_sample_many
+  from glt_tpu.ops.sample import sample_neighbors
+  from glt_tpu.ops.unique import dense_assign, dense_init, \
+      dense_make_tables, dense_reset
+
+  rng = np.random.default_rng(0)
+  src = rng.integers(0, NUM_NODES, NUM_EDGES, dtype=np.int64)
+  dst = (rng.random(NUM_EDGES) ** 2 * NUM_NODES).astype(np.int64) \
+      % NUM_NODES
+  topo = Topology(indptr=None, edge_index=np.stack([src, dst]),
+                  num_nodes=NUM_NODES)
+  del src, dst
+  indptr = jnp.asarray(topo.indptr.astype(np.int32))
+  indices = jnp.asarray(topo.indices)
+  key = jax.random.key(0)
+
+  stages = {}
+
+  # per-hop one_hop and dense_assign at the real frontier widths
+  width = BATCH
+  for h, k in enumerate(FANOUT):
+    frontier = jnp.asarray(
+        rng.integers(0, NUM_NODES, width).astype(np.int32))
+    mask = jnp.ones((width,), bool)
+
+    @jax.jit
+    def hop_only(fr, m, key, _k=k):
+      out = sample_neighbors(indptr, indices, fr, _k, key, seed_mask=m)
+      return out.nbrs, out.mask
+
+    stages[f'one_hop_h{h}'] = _time_fn(
+        lambda fr, m: hop_only(fr, m, key), (frontier, mask),
+        iters=args.iters)
+
+    nbrs = np.asarray(hop_only(frontier, mask, key)[0]).reshape(-1)
+    nmask = np.asarray(hop_only(frontier, mask, key)[1]).reshape(-1)
+    budget = width * k + 8
+
+    @functools.partial(jax.jit, donate_argnums=(2, 3))
+    def assign_only(ids, ok, table, scratch, _budget=budget):
+      state = dense_init(table, scratch, _budget)
+      state, labels = dense_assign(state, ids, ok)
+      table, scratch = dense_reset(state)
+      return labels, table, scratch
+
+    table, scratch = dense_make_tables(NUM_NODES)
+    stages[f'assign_h{h}'] = _time_fn(
+        assign_only,
+        (jnp.asarray(nbrs), jnp.asarray(nmask), table, scratch),
+        iters=args.iters, donate_state=True)
+    width *= k
+
+  # composed program (bench.py's work unit)
+  one_hop = lambda ids, fanout, key, mask: sample_neighbors(
+      indptr, indices, ids, fanout, key, seed_mask=mask)
+
+  @functools.partial(jax.jit, donate_argnums=(2, 3))
+  def composed(seeds, key, table, scratch):
+    out, table, scratch = multihop_sample(
+        one_hop, seeds, jnp.asarray(BATCH), FANOUT, key, table, scratch)
+    return out['num_sampled_edges'].sum(), table, scratch
+
+  table, scratch = dense_make_tables(NUM_NODES)
+  seeds = jnp.asarray(rng.integers(0, NUM_NODES, BATCH).astype(np.int32))
+  stages['composed'] = _time_fn(composed, (seeds, key, table, scratch),
+                                iters=args.iters, donate_state=True)
+
+  scan = max(int(os.environ.get('GLT_BENCH_SCAN', '4')), 1)
+
+  @functools.partial(jax.jit, donate_argnums=(2, 3))
+  def composed_scan(seeds2, key, table, scratch):
+    outs, table, scratch = multihop_sample_many(
+        one_hop, seeds2, jnp.full(scan, BATCH, jnp.int32), FANOUT, key,
+        table, scratch)
+    return outs['num_sampled_edges'].sum(), table, scratch
+
+  seeds2 = jnp.asarray(
+      rng.integers(0, NUM_NODES, (scan, BATCH)).astype(np.int32))
+  table, scratch = dense_make_tables(NUM_NODES)
+  stages['composed_scan_per_batch'] = _time_fn(
+      composed_scan, (seeds2, key, table, scratch),
+      iters=args.iters, donate_state=True) / scan
+
+  if args.trace:
+    table, scratch = dense_make_tables(NUM_NODES)
+    state = (seeds, key, table, scratch)
+    out = composed(*state)  # ensure compiled before tracing
+    jax.block_until_ready(out)
+    with jax.profiler.trace(args.trace):
+      for _ in range(10):
+        out = composed(state[0], state[1], out[1], out[2])
+      jax.block_until_ready(out)
+    print(f'# trace written to {args.trace}')
+
+  ms = {k: round(v * 1e3, 3) for k, v in stages.items()}
+  op_sum = sum(v for k, v in ms.items() if not k.startswith('composed'))
+  top3 = sorted((k for k in ms if not k.startswith('composed')),
+                key=lambda k: -ms[k])[:3]
+  dev = jax.devices()[0]
+  print(json.dumps({
+      'metric': 'sampler_stage_ms',
+      'stages': ms,
+      'op_sum_ms': round(op_sum, 3),
+      'composed_over_opsum': round(ms['composed'] / max(op_sum, 1e-9), 2),
+      'top3': top3,
+      'backend': dev.platform,
+  }))
+
+
+if __name__ == '__main__':
+  main()

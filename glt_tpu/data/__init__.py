@@ -8,6 +8,9 @@ __all__ = [
     'Topology', 'Graph', 'Feature', 'Dataset',
     'sort_by_in_degree', 'in_degrees',
 ]
-from .table_dataset import TableDataset, csv_edge_reader
+from .table_dataset import (
+    TableDataset, csv_edge_reader, csv_node_reader, odps_table_reader,
+)
 
-__all__ += ['TableDataset', 'csv_edge_reader']
+__all__ += ['TableDataset', 'csv_edge_reader', 'csv_node_reader',
+            'odps_table_reader']

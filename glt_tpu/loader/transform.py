@@ -70,6 +70,10 @@ class HeteroBatch:
   input_type: Optional[NodeType] = flax.struct.field(
       pytree_node=False, default=None)
   batch_size: int = flax.struct.field(pytree_node=False, default=0)
+  #: static per-etype hop offsets into the edge buffers (hierarchical
+  #: per-layer trimming, reference trim_to_layer); Dict[etype, tuple]
+  edge_hop_offsets_dict: Optional[Dict] = flax.struct.field(
+      pytree_node=False, default=None)
 
   def edge_index_dict(self) -> Dict[EdgeType, jax.Array]:
     return {k: jnp.stack([self.row_dict[k], self.col_dict[k]])
@@ -103,6 +107,10 @@ def to_batch(out: SamplerOutput,
 def to_hetero_batch(out: HeteroSamplerOutput,
                     x_dict=None, y_dict=None, edge_attr_dict=None,
                     batch_size: Optional[int] = None) -> HeteroBatch:
+  # hop offsets are STATIC config, not batch data: they live in the
+  # non-pytree field below and must not leak into the traced metadata
+  meta = {k: v for k, v in (out.metadata or {}).items()
+          if k != 'edge_hop_offsets'}
   return HeteroBatch(
       x_dict=x_dict or {},
       row_dict=out.row, col_dict=out.col, edge_mask_dict=out.edge_mask,
@@ -110,10 +118,18 @@ def to_hetero_batch(out: HeteroSamplerOutput,
       y_dict=y_dict, edge_attr_dict=edge_attr_dict, edge_dict=out.edge,
       num_sampled_nodes=out.num_sampled_nodes,
       num_sampled_edges=out.num_sampled_edges,
-      metadata=out.metadata, input_type=out.input_type,
+      metadata=meta, input_type=out.input_type,
       batch_size=batch_size if batch_size is not None
       else (out.batch[out.input_type].shape[0] if out.batch else 0),
+      edge_hop_offsets_dict=_freeze_offsets(
+          (out.metadata or {}).get('edge_hop_offsets')),
   )
+
+
+def _freeze_offsets(offs):
+  if not offs:
+    return None
+  return {k: tuple(v) for k, v in offs.items()}
 
 
 def to_pyg_v1(batch: Batch):

@@ -68,7 +68,13 @@ class HeteroConvLayer(nn.Module):
 
 class RGNN(nn.Module):
   """Relational GNN stack (reference examples/igbh/rgnn.py): 'rsage' or
-  'rgat' layers over a HeteroBatch, classifier head on the seed type."""
+  'rgat' layers over a HeteroBatch, classifier head on the seed type.
+
+  When the batch carries ``edge_hop_offsets_dict`` (hetero NeighborLoader
+  batches do), layers trim hierarchically: layer i only reads the edge
+  slots of hops [0, num_hops - i) per edge type — the reference's
+  trim_to_layer (examples/hetero/hierarchical_sage.py), as static slices.
+  """
   edge_types: Sequence[EdgeType]
   hidden_features: int
   out_features: int
@@ -76,20 +82,37 @@ class RGNN(nn.Module):
   conv: str = 'rsage'      # 'rsage' | 'rgat'
   heads: int = 4
   dropout: float = 0.0
+  trim: bool = True
 
   @nn.compact
   def __call__(self, batch: HeteroBatch, train: bool = False,
                return_all: bool = False):
     conv_kind = 'gat' if self.conv == 'rgat' else 'sage'
     x_dict = dict(batch.x_dict)
+    offs = batch.edge_hop_offsets_dict if self.trim else None
+    num_hops = (max(len(v) for v in offs.values()) - 1) if offs else 0
     for i in range(self.num_layers):
       dim = (self.hidden_features if i < self.num_layers - 1
              else self.out_features)
+      if offs is not None:
+        # layer i still feeds num_layers-1-i later propagations, so hop
+        # h is useful iff h <= num_layers - i (clamped to sampled hops)
+        keep = max(min(num_hops, self.num_layers - i), 1)
+        row_d, col_d, mask_d = {}, {}, {}
+        for e, v in batch.row_dict.items():
+          end = offs[e][min(keep, len(offs[e]) - 1)] \
+              if e in offs else v.shape[0]
+          end = max(end, 1)  # keep shapes non-empty for XLA
+          row_d[e] = v[:end]
+          col_d[e] = batch.col_dict[e][:end]
+          mask_d[e] = batch.edge_mask_dict[e][:end]
+      else:
+        row_d, col_d, mask_d = (batch.row_dict, batch.col_dict,
+                                batch.edge_mask_dict)
       x_dict = HeteroConvLayer(
           edge_types=list(self.edge_types), out_features=dim,
           conv=conv_kind, heads=self.heads, name=f'layer{i}')(
-              x_dict, batch.row_dict, batch.col_dict,
-              batch.edge_mask_dict)
+              x_dict, row_d, col_d, mask_d)
       if i < self.num_layers - 1:
         x_dict = {t: nn.relu(v) for t, v in x_dict.items()}
         if self.dropout > 0:

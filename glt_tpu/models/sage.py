@@ -46,9 +46,11 @@ class GraphSAGE(nn.Module):
       dim = (self.hidden_features if i < self.num_layers - 1
              else self.out_features)
       if self.trim and offsets is not None:
-        # layer i only needs hops [0, num_hops - i): later-hop edges feed
-        # representations no later layer reads
-        end = offsets[max(num_hops - i, 1)]
+        # layer i still feeds num_layers-1-i later propagations, so hop
+        # h is useful iff h <= num_layers - i (clamped to sampled hops);
+        # later-hop edges feed representations no later layer reads
+        keep = max(min(num_hops, self.num_layers - i), 1)
+        end = offsets[keep]
         r, c, m = row[:end], col[:end], mask[:end]
       else:
         r, c, m = row, col, mask

@@ -112,6 +112,20 @@ def hetero_edge_capacities(caps, trav, num_neighbors, num_hops):
   return out
 
 
+def hetero_edge_hop_offsets(caps, trav, num_neighbors, num_hops):
+  """Per-etype cumulative hop offsets into the concatenated edge
+  buffers — the hetero counterpart of :func:`edge_hop_offsets`, used for
+  hierarchical per-layer trimming (reference trim_to_layer over
+  num_sampled_edges_dict, examples/hetero/hierarchical_sage.py)."""
+  offs = {e: [0] for e in trav}
+  for h in range(num_hops):
+    for e, (row_t, _) in trav.items():
+      k = num_neighbors[e][h]
+      w = caps[h][row_t] * abs(k) if (caps[h][row_t] and k) else 0
+      offs[e].append(offs[e][-1] + w)
+  return offs
+
+
 def multihop_sample_hetero(one_hops, trav, num_neighbors, num_hops,
                            caps, budgets, seeds, n_valid, key, tables,
                            with_edge: bool = False):

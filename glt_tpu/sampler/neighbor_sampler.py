@@ -26,8 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..data import Graph
-from ..ops.pipeline import edge_hop_offsets, multihop_sample, \
-    multihop_sample_hetero
+from ..ops.pipeline import edge_hop_offsets, hetero_edge_hop_offsets, \
+    multihop_sample, multihop_sample_hetero
 from ..ops.sample import (
     neighbor_probs, sample_full_neighbors, sample_neighbors,
     sample_neighbors_weighted,
@@ -323,6 +323,19 @@ class NeighborSampler(BaseSampler):
             if self.with_edge else None)
     num_sampled_edges = {final_key(e): v
                          for e, v in out['num_sampled_edges'].items()}
+    # static per-etype hop offsets (final-key space) for hierarchical
+    # per-layer trimming (reference trim_to_layer) — cached per
+    # batch-size signature alongside the compiled fn
+    offs_key = ('hetero_offs', cache_key[1])
+    if offs_key not in self._fn_cache:
+      caps, _ = self._hetero_caps(batch_sizes)
+      raw = hetero_edge_hop_offsets(
+          caps, self._traversal_types(), self.num_neighbors,
+          self.num_hops)
+      self._fn_cache[offs_key] = {
+          final_key(e): tuple(v) for e, v in raw.items()}
+    hop_offs = {k: v for k, v in self._fn_cache[offs_key].items()
+                if k in row}
     return HeteroSamplerOutput(
         node=out['node'], node_count=out['node_count'],
         row=row, col=col, edge_mask=edge_mask, edge=edge,
@@ -330,7 +343,8 @@ class NeighborSampler(BaseSampler):
         num_sampled_nodes=out['num_sampled_nodes'],
         num_sampled_edges=num_sampled_edges,
         input_type=seed_type,
-        metadata={'seed_labels': out['seed_labels']},
+        metadata={'seed_labels': out['seed_labels'],
+                  'edge_hop_offsets': hop_offs},
     )
 
   # -- link sampling (reference neighbor_sampler.py:319-446) --------------

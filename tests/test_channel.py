@@ -305,3 +305,36 @@ def test_shm_channel_multi_producer_multi_consumer():
       assert sorted(per[pid]) == list(range(n))
   finally:
     chan.close()
+
+
+def test_table_dataset_hetero_tables(tmp_path):
+  """Hetero table loading (reference TableDataset.load edge_tables/
+  node_tables dicts) via the reader protocol + CSV stand-ins."""
+  from glt_tpu.data import TableDataset, csv_edge_reader, csv_node_reader
+  u2i = ('user', 'buys', 'item')
+  i2i = ('item', 'sim', 'item')
+  (tmp_path / 'u2i.csv').write_text('0,0\n1,1\n2,0\n')
+  (tmp_path / 'i2i.csv').write_text('0,1\n1,0\n')
+  (tmp_path / 'users.csv').write_text(
+      '0,1:0,0\n1,0:1,1\n2,1:1,0\n')
+  (tmp_path / 'items.csv').write_text('0,5:5\n1,6:6\n')
+  ds = TableDataset(edge_dir='out').load_tables(
+      edge_tables={u2i: csv_edge_reader(str(tmp_path / 'u2i.csv')),
+                   i2i: csv_edge_reader(str(tmp_path / 'i2i.csv'))},
+      node_tables={'user': csv_node_reader(str(tmp_path / 'users.csv'),
+                                           label_col=2),
+                   'item': csv_node_reader(str(tmp_path / 'items.csv'))})
+  assert ds.is_hetero
+  assert ds.graph[u2i].num_edges == 3
+  assert ds.graph[i2i].num_edges == 2
+  np.testing.assert_allclose(
+      ds.node_features['item'][np.array([1])][0], [6, 6])
+  np.testing.assert_array_equal(np.asarray(ds.node_labels['user']),
+                                [0, 1, 0])
+
+
+def test_odps_reader_gated():
+  import pytest
+  from glt_tpu.data import odps_table_reader
+  with pytest.raises(ImportError):
+    next(iter(odps_table_reader('odps://proj/tables/edges')))

@@ -114,3 +114,23 @@ def test_trim_does_not_change_seed_outputs():
   out_f = full.apply(params, b)
   np.testing.assert_allclose(np.asarray(out_t), np.asarray(out_f),
                              rtol=1e-5, atol=1e-6)
+
+
+def test_trim_equivalence_more_layers_than_hops():
+  """num_layers > num_hops: layers must keep every hop they can still
+  propagate (regression for the over-trim at layer i > 0)."""
+  import sys, os
+  sys.path.insert(0, os.path.dirname(__file__))
+  from fixtures import ring_dataset
+  from glt_tpu.loader import NeighborLoader
+  from glt_tpu.models import GraphSAGE
+  ds = ring_dataset(num_nodes=40, feat_dim=8)
+  loader = NeighborLoader(ds, [2, 2], input_nodes=np.arange(8),
+                          batch_size=8, seed=0)
+  b = next(iter(loader))
+  kw = dict(hidden_features=16, out_features=4, num_layers=3)
+  params = GraphSAGE(trim=True, **kw).init(jax.random.key(0), b)
+  out_t = GraphSAGE(trim=True, **kw).apply(params, b)
+  out_f = GraphSAGE(trim=False, **kw).apply(params, b)
+  np.testing.assert_allclose(np.asarray(out_t), np.asarray(out_f),
+                             rtol=1e-5, atol=1e-6)

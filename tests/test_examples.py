@@ -30,7 +30,7 @@ def test_train_sage_example():
 
 
 def test_unsup_example():
-  out = _run('graph_sage_unsup.py', '--epochs', '1')
+  out = _run('graph_sage_unsup.py', '--epochs', '1', timeout=300)
   assert 'loss=' in out
 
 
@@ -79,3 +79,16 @@ def test_dist_sage_unsup_example():
              '--nodes', '600', '--epochs', '1', '--batch-size', '8',
              timeout=400)
   assert 'loss=' in out
+
+
+def test_hierarchical_sage_example():
+  out = _run(os.path.join('hetero', 'hierarchical_sage.py'),
+             '--epochs', '1', '--papers', '1000', '--batch-size', '64',
+             timeout=300)
+  assert 'loss=' in out
+
+
+def test_bipartite_sage_unsup_example():
+  out = _run(os.path.join('hetero', 'bipartite_sage_unsup.py'),
+             '--epochs', '2', '--users', '300', timeout=400)
+  assert 'test_auc=' in out

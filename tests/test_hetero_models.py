@@ -112,3 +112,55 @@ def test_hgt_learns():
               hidden_features=32, out_features=3, num_layers=2, heads=2)
   losses = _train(model, loader, steps=60, lr=3e-3)
   assert losses[-1] < 0.5, f'HGT did not learn: {losses[::12]}'
+
+
+def test_hetero_trim_equivalence():
+  """RGNN hierarchical trimming must not change seed outputs: trimmed
+  hops feed representations no later layer reads (reference
+  trim_to_layer semantics)."""
+  import jax
+  import numpy as np
+  from fixtures import hetero_ring_dataset
+  from glt_tpu.loader import NeighborLoader
+  from glt_tpu.models import RGNN
+  from glt_tpu.typing import reverse_edge_type
+
+  ds = hetero_ring_dataset(num_users=12, num_items=24)
+  u2i = ('user', 'u2i', 'item')
+  i2i = ('item', 'i2i', 'item')
+  loader = NeighborLoader(ds, [3, 2], ('user', np.arange(12)),
+                          batch_size=4, shuffle=False, seed=0)
+  batch = next(iter(loader))
+  assert batch.edge_hop_offsets_dict
+  kw = dict(edge_types=[reverse_edge_type(u2i), i2i],
+            hidden_features=8, out_features=3, num_layers=2,
+            conv='rsage')
+  trimmed = RGNN(trim=True, **kw)
+  full = RGNN(trim=False, **kw)
+  params = trimmed.init(jax.random.key(0), batch)
+  out_t = np.asarray(trimmed.apply(params, batch))
+  out_f = np.asarray(full.apply(params, batch))
+  np.testing.assert_allclose(out_t, out_f, rtol=1e-5, atol=1e-5)
+
+
+def test_hetero_trim_equivalence_more_layers_than_hops():
+  import jax
+  import numpy as np
+  from fixtures import hetero_ring_dataset
+  from glt_tpu.loader import NeighborLoader
+  from glt_tpu.models import RGNN
+  from glt_tpu.typing import reverse_edge_type
+
+  ds = hetero_ring_dataset(num_users=12, num_items=24)
+  u2i = ('user', 'u2i', 'item')
+  i2i = ('item', 'i2i', 'item')
+  loader = NeighborLoader(ds, [2, 2], ('user', np.arange(8)),
+                          batch_size=4, shuffle=False, seed=0)
+  batch = next(iter(loader))
+  kw = dict(edge_types=[reverse_edge_type(u2i), i2i],
+            hidden_features=8, out_features=3, num_layers=3,
+            conv='rsage')
+  params = RGNN(trim=True, **kw).init(jax.random.key(0), batch)
+  out_t = np.asarray(RGNN(trim=True, **kw).apply(params, batch))
+  out_f = np.asarray(RGNN(trim=False, **kw).apply(params, batch))
+  np.testing.assert_allclose(out_t, out_f, rtol=1e-5, atol=1e-5)
