@@ -7,15 +7,16 @@ reports approx_acc ~= 0.787 on real ogbn-products after 20 epochs).
 Synthetic <-> real mapping (datasets are not downloadable here): the
 graph is products-scale (2.45M nodes / ~61M directed edges, skewed
 in-degrees) and labels are the argmax of a fixed random linear map of
-the features, so the task's attainable accuracy is ~1.0 and the
-measured quantities decompose as:
+each node's features BLENDED WITH its mean out-neighbor features — the
+label signal deliberately lives partly in the graph structure, as it
+does in real products. The measured quantities decompose as:
   * epoch_seconds — directly comparable to the reference's wall-clock
     per epoch at identical shapes (same sampled work per step).
   * test_acc — NOT comparable to 0.787 in value (different label
-    process); comparable in KIND: it must climb well above the
-    feature-only linear baseline printed alongside it
-    (``linear_probe_acc``), which proves the sampled-neighborhood
-    pipeline trains, generalizes, and beats its input features.
+    process); comparable in KIND: it must climb above the feature-only
+    linear baseline printed alongside it (``linear_probe_acc``), which
+    a model can only do by aggregating sampled neighborhoods — the
+    capability the reference's accuracy number certifies.
 
 Prints one JSON line: epoch seconds + accuracy evidence.
 ``GLT_BENCH_PLATFORM=cpu`` forces the CPU backend (the axon TPU plugin
@@ -70,8 +71,20 @@ def main():
   dst = (rng.random(e) ** 2 * n).astype(np.int64) % n
   feats = rng.normal(size=(n, args.feat_dim)).astype(np.float32)
   w = rng.normal(size=(args.feat_dim, 47)).astype(np.float32)
-  logits_true = feats @ w
-  labels = np.argmax(logits_true, 1).astype(np.int32)
+  # neighborhood-dependent labels: own features + mean out-neighbor
+  # features, so beating the feature-only probe REQUIRES aggregation.
+  # Chunked scatter: a whole-edge feats[dst] temporary would be
+  # edges x feat_dim x 4B (~24 GB at default scale).
+  nbr_sum = np.zeros_like(feats)
+  deg = np.zeros(n, np.float32)
+  chunk = 2_000_000
+  for lo in range(0, e, chunk):
+    s_c, d_c = src[lo:lo + chunk], dst[lo:lo + chunk]
+    np.add.at(nbr_sum, s_c, feats[d_c])
+    np.add.at(deg, s_c, 1.0)
+  blended = feats + nbr_sum / np.maximum(deg, 1.0)[:, None]
+  labels = np.argmax(blended @ w, 1).astype(np.int32)
+  del nbr_sum, blended
   ds = Dataset(edge_dir='out')
   ds.init_graph(edge_index=np.stack([src, dst]), num_nodes=n)
   del src, dst

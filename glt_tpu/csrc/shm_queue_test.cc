@@ -1,0 +1,197 @@
+// Native unit tests for the SysV shm ring buffer — the reference keeps
+// googletest binaries for its native layer (test/cpp/test_shm_queue.cu);
+// this is the plain-assert equivalent (no gtest in this image).
+//
+// Covers: FIFO order, wraparound with variable block sizes, dequeue
+// timeout, -EMSGSIZE refusal without consumption, cross-process
+// transfer via fork, multi-threaded producers/consumers, and survival
+// of a consumer killed while blocked (robust-mutex path must leave the
+// queue usable for everyone else).
+//
+// Build & run: make -C glt_tpu/csrc test
+#include <cassert>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+#include <pthread.h>
+#include <signal.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+extern "C" {
+int shmq_create(uint64_t capacity);
+void* shmq_attach(int shmid);
+int shmq_detach(void* handle);
+int shmq_destroy(int shmid);
+int shmq_enqueue(void* handle, const void* data, uint64_t size,
+                 int timeout_ms);
+int64_t shmq_peek_size(void* handle, int timeout_ms);
+int64_t shmq_dequeue(void* handle, void* out, uint64_t cap,
+                     int timeout_ms);
+uint64_t shmq_size(void* handle);
+}
+
+static void test_fifo_and_wraparound() {
+  int id = shmq_create(1 << 12);
+  assert(id >= 0);
+  void* q = shmq_attach(id);
+  assert(q);
+  // deterministic xorshift PRNG (rand_r needs _POSIX_C_SOURCE)
+  uint32_t seed = 7;
+  auto next = [&seed]() {
+    seed ^= seed << 13; seed ^= seed >> 17; seed ^= seed << 5;
+    return seed;
+  };
+  std::vector<std::vector<char>> sent;
+  for (int round = 0; round < 50; ++round) {
+    sent.clear();
+    for (int i = 0; i < 4; ++i) {
+      int len = 1 + next() % 700;
+      std::vector<char> buf(len);
+      for (int j = 0; j < len; ++j) buf[j] = char(next());
+      assert(shmq_enqueue(q, buf.data(), buf.size(), 1000) == 0);
+      sent.push_back(buf);
+    }
+    for (auto& buf : sent) {
+      char out[1024];
+      int64_t got = shmq_dequeue(q, out, sizeof(out), 1000);
+      assert(got == (int64_t)buf.size());
+      assert(std::memcmp(out, buf.data(), got) == 0);
+    }
+  }
+  assert(shmq_size(q) == 0);
+  shmq_detach(q);
+  shmq_destroy(id);
+  std::puts("fifo_and_wraparound ok");
+}
+
+static void test_timeout_and_msgsize() {
+  int id = shmq_create(1 << 10);
+  void* q = shmq_attach(id);
+  assert(shmq_dequeue(q, nullptr, 0, 50) == -ETIMEDOUT);
+  char big[4096];
+  assert(shmq_enqueue(q, big, sizeof(big), 50) == -EMSGSIZE);
+  // undersized output buffer refuses WITHOUT consuming
+  const char* msg = "hello";
+  assert(shmq_enqueue(q, msg, 5, 100) == 0);
+  char tiny[2];
+  assert(shmq_dequeue(q, tiny, sizeof(tiny), 100) == -EMSGSIZE);
+  assert(shmq_size(q) == 1);
+  char out[16];
+  assert(shmq_dequeue(q, out, sizeof(out), 100) == 5);
+  shmq_detach(q);
+  shmq_destroy(id);
+  std::puts("timeout_and_msgsize ok");
+}
+
+static void test_cross_process() {
+  int id = shmq_create(1 << 14);
+  pid_t pid = fork();
+  if (pid == 0) {  // child: producer
+    void* q = shmq_attach(id);
+    for (int i = 0; i < 200; ++i) {
+      assert(shmq_enqueue(q, &i, sizeof(i), 5000) == 0);
+    }
+    shmq_detach(q);
+    _exit(0);
+  }
+  void* q = shmq_attach(id);
+  for (int i = 0; i < 200; ++i) {
+    int v = -1;
+    assert(shmq_dequeue(q, &v, sizeof(v), 5000) == sizeof(int));
+    assert(v == i);
+  }
+  int status = 0;
+  waitpid(pid, &status, 0);
+  assert(WIFEXITED(status) && WEXITSTATUS(status) == 0);
+  shmq_detach(q);
+  shmq_destroy(id);
+  std::puts("cross_process ok");
+}
+
+struct ThreadArg {
+  void* q;
+  int n;
+  long sum;
+};
+
+static void* producer_main(void* p) {
+  auto* a = static_cast<ThreadArg*>(p);
+  for (int i = 1; i <= a->n; ++i) {
+    assert(shmq_enqueue(a->q, &i, sizeof(i), 10000) == 0);
+  }
+  return nullptr;
+}
+
+static void* consumer_main(void* p) {
+  auto* a = static_cast<ThreadArg*>(p);
+  for (int i = 0; i < a->n; ++i) {
+    int v = 0;
+    int64_t got = shmq_dequeue(a->q, &v, sizeof(v), 10000);
+    assert(got == sizeof(int));
+    a->sum += v;
+  }
+  return nullptr;
+}
+
+static void test_mpmc_threads() {
+  int id = shmq_create(1 << 12);  // small: heavy contention + wrap
+  void* q = shmq_attach(id);
+  const int kPer = 500;
+  ThreadArg prod[3] = {{q, kPer, 0}, {q, kPer, 0}, {q, kPer, 0}};
+  ThreadArg cons[3] = {{q, kPer, 0}, {q, kPer, 0}, {q, kPer, 0}};
+  pthread_t pt[3], ct[3];
+  for (int i = 0; i < 3; ++i) pthread_create(&ct[i], nullptr,
+                                             consumer_main, &cons[i]);
+  for (int i = 0; i < 3; ++i) pthread_create(&pt[i], nullptr,
+                                             producer_main, &prod[i]);
+  for (int i = 0; i < 3; ++i) pthread_join(pt[i], nullptr);
+  long total = 0;
+  for (int i = 0; i < 3; ++i) {
+    pthread_join(ct[i], nullptr);
+    total += cons[i].sum;
+  }
+  long expect = 3L * kPer * (kPer + 1) / 2;
+  assert(total == expect);
+  assert(shmq_size(q) == 0);
+  shmq_detach(q);
+  shmq_destroy(id);
+  std::puts("mpmc_threads ok");
+}
+
+static void test_killed_consumer_leaves_queue_usable() {
+  int id = shmq_create(1 << 12);
+  pid_t pid = fork();
+  if (pid == 0) {  // child: blocks forever on an empty queue
+    void* q = shmq_attach(id);
+    int v;
+    shmq_dequeue(q, &v, sizeof(v), 60000);
+    _exit(1);  // unreachable
+  }
+  usleep(100 * 1000);  // let the child block inside the cond wait
+  kill(pid, SIGKILL);
+  waitpid(pid, nullptr, 0);
+  // the queue must remain fully usable for everyone else
+  void* q = shmq_attach(id);
+  int v = 42;
+  assert(shmq_enqueue(q, &v, sizeof(v), 1000) == 0);
+  int out = 0;
+  assert(shmq_dequeue(q, &out, sizeof(out), 1000) == sizeof(int));
+  assert(out == 42);
+  shmq_detach(q);
+  shmq_destroy(id);
+  std::puts("killed_consumer ok");
+}
+
+int main() {
+  test_fifo_and_wraparound();
+  test_timeout_and_msgsize();
+  test_cross_process();
+  test_mpmc_threads();
+  test_killed_consumer_leaves_queue_usable();
+  std::puts("ALL NATIVE TESTS PASSED");
+  return 0;
+}

@@ -338,3 +338,17 @@ def test_odps_reader_gated():
   from glt_tpu.data import odps_table_reader
   with pytest.raises(ImportError):
     next(iter(odps_table_reader('odps://proj/tables/edges')))
+
+
+def test_native_shm_queue_binary():
+  """Build and run the native C++ test binary (the reference keeps
+  googletest binaries for its native layer; csrc/shm_queue_test.cc is
+  the plain-assert equivalent)."""
+  import os
+  import subprocess
+  csrc = os.path.join(os.path.dirname(os.path.dirname(
+      os.path.abspath(__file__))), 'glt_tpu', 'csrc')
+  out = subprocess.run(['make', '-C', csrc, 'test'],
+                       capture_output=True, text=True, timeout=300)
+  assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+  assert 'ALL NATIVE TESTS PASSED' in out.stdout
