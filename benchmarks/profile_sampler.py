@@ -168,14 +168,30 @@ def main():
   top3 = sorted((k for k in ms if not k.startswith('composed')),
                 key=lambda k: -ms[k])[:3]
   dev = jax.devices()[0]
-  print(json.dumps({
+  out = {
       'metric': 'sampler_stage_ms',
       'stages': ms,
       'op_sum_ms': round(op_sum, 3),
       'composed_over_opsum': round(ms['composed'] / max(op_sum, 1e-9), 2),
       'top3': top3,
       'backend': dev.platform,
-  }))
+  }
+  try:
+    # XLA's own estimate of the composed program's work: bytes accessed
+    # vs flops shows how bandwidth-bound the sampler is. lower() only
+    # needs avals, so pass shape specs instead of fresh device buffers.
+    spec = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
+    t_spec = jax.ShapeDtypeStruct((NUM_NODES + 1,), jnp.int32)
+    ca = composed.lower(spec(seeds), spec(key), t_spec, t_spec) \
+        .compile().cost_analysis()
+    if isinstance(ca, (list, tuple)):
+      ca = ca[0] if ca else {}
+    out['cost_analysis'] = {
+        k: float(ca[k]) for k in ('flops', 'bytes accessed')
+        if k in ca}
+  except Exception as e:  # cost model availability varies by backend
+    out['cost_analysis_error'] = str(e)[:120]
+  print(json.dumps(out))
 
 
 if __name__ == '__main__':

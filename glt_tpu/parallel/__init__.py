@@ -6,7 +6,9 @@ __all__ = ['make_mesh', 'replicated', 'row_sharded', 'ShardedFeature',
            'SPMDSageTrainStep']
 from . import multihost
 from .collectives import (all_to_all, bucket_by_owner, bucket_payload,
-                          sharded_segment_mean, unbucket)
+                          sharded_segment_mean,
+                          sharded_segment_mean_scattered, unbucket)
 
 __all__ += ['multihost', 'all_to_all', 'bucket_by_owner',
-            'bucket_payload', 'sharded_segment_mean', 'unbucket']
+            'bucket_payload', 'sharded_segment_mean',
+            'sharded_segment_mean_scattered', 'unbucket']

@@ -102,12 +102,43 @@ def sharded_segment_mean(msgs: jax.Array, targets: jax.Array,
 
   Returns [num_segments, D] — identical on every device.
   """
+  total, cnt = _local_segment_sums(msgs, targets, mask, num_segments)
+  total = jax.lax.psum(total, axis_name)
+  cnt = jax.lax.psum(cnt, axis_name)
+  return total / jnp.maximum(cnt[:, None], 1.0)
+
+
+def _local_segment_sums(msgs, targets, mask, num_segments):
+  """This device's masked (sum, count) per segment."""
   seg = jnp.where(mask, targets, num_segments)
   total = jax.ops.segment_sum(
       jnp.where(mask[:, None], msgs, 0.0), seg, num_segments + 1
   )[:num_segments]
   cnt = jax.ops.segment_sum(mask.astype(msgs.dtype), seg,
                             num_segments + 1)[:num_segments]
-  total = jax.lax.psum(total, axis_name)
-  cnt = jax.lax.psum(cnt, axis_name)
+  return total, cnt
+
+
+def sharded_segment_mean_scattered(msgs: jax.Array, targets: jax.Array,
+                                   mask: jax.Array, num_segments: int,
+                                   axis_name: str) -> jax.Array:
+  """Ring (reduce-scatter) variant of :func:`sharded_segment_mean`:
+  the aggregated output stays SHARDED — device i returns only its
+  segment block [i*S/P, (i+1)*S/P) — so per-device memory and ICI
+  bandwidth drop by the mesh size. ``psum_scatter`` lowers to the ring
+  reduce-scatter on ICI (the reduce half of ring attention; the GNN
+  mean replaces the softmax).
+
+  ``num_segments`` must be divisible by the axis size. Returns
+  [num_segments / P, D].
+  """
+  n_dev = jax.lax.axis_size(axis_name)
+  assert num_segments % n_dev == 0, (
+      f'num_segments ({num_segments}) must divide by the axis size '
+      f'({n_dev}) for the scattered layout')
+  total, cnt = _local_segment_sums(msgs, targets, mask, num_segments)
+  total = jax.lax.psum_scatter(total, axis_name, scatter_dimension=0,
+                               tiled=True)
+  cnt = jax.lax.psum_scatter(cnt, axis_name, scatter_dimension=0,
+                             tiled=True)
   return total / jnp.maximum(cnt[:, None], 1.0)

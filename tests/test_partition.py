@@ -117,3 +117,39 @@ def test_hetero_partition_roundtrip(tmp_path):
   assert set(nf) <= {'user', 'item'}
   assert node_pb['user'].table.shape[0] == 4
   assert node_pb['item'].table.shape[0] == 10
+
+
+def test_frequency_partitioner_hetero(tmp_path):
+  """Hetero FrequencyPartitioner: per-ntype prob dicts drive assignment
+  and hot-row caching per node type (reference
+  frequency_partitioner.py hetero loops)."""
+  u2i = ('user', 'u2i', 'item')
+  nu, ni = 20, 30
+  u = np.arange(nu)
+  # user u -> items (u, u+1) % ni
+  ei = {u2i: np.stack([np.repeat(u, 2),
+                       (np.repeat(u, 2)
+                        + np.tile(np.arange(2), nu)) % ni])}
+  feats = {'user': np.tile(np.arange(nu, dtype=np.float32)[:, None],
+                           (1, 4)),
+           'item': np.tile(np.arange(ni, dtype=np.float32)[:, None],
+                           (1, 4))}
+  probs = {
+      'user': np.stack([(np.arange(nu) < 10).astype(np.float32),
+                        (np.arange(nu) >= 10).astype(np.float32)]),
+      'item': np.stack([(np.arange(ni) < 15).astype(np.float32),
+                        (np.arange(ni) >= 15).astype(np.float32)]),
+  }
+  probs['item'][1, 0] = 0.5   # partition 1 also wants item 0 (cache)
+  p = FrequencyPartitioner(str(tmp_path), num_parts=2,
+                           num_nodes={'user': nu, 'item': ni},
+                           edge_index=ei, node_feat=feats,
+                           probs=probs, cache_ratio=0.1)
+  p.partition()
+  _, _, _, _, node_pb, _ = load_partition(str(tmp_path), 0)
+  assert set(np.nonzero(node_pb['user'].table == 0)[0]) == \
+      set(range(10))
+  assert set(np.nonzero(node_pb['item'].table == 0)[0]) == \
+      set(range(15))
+  _, _, nfeat1, _, _, _ = load_partition(str(tmp_path), 1)
+  assert 0 in nfeat1['item'].cache_ids  # hot remote item row cached
