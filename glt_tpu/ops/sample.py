@@ -41,6 +41,17 @@ class NeighborOutput(NamedTuple):
     return self.mask.sum(axis=-1)
 
 
+def _empty_output(s: int, width: int, indices, edge_ids,
+                  indptr) -> 'NeighborOutput':
+  """All-masked output for a zero-edge graph; dtypes follow the same
+  contract as the non-empty paths (nbrs: indices.dtype, eids:
+  edge_ids.dtype, or the indptr-derived slot dtype)."""
+  eid_dtype = edge_ids.dtype if edge_ids is not None else indptr.dtype
+  return NeighborOutput(nbrs=jnp.zeros((s, width), indices.dtype),
+                        mask=jnp.zeros((s, width), bool),
+                        eids=jnp.full((s, width), -1, eid_dtype))
+
+
 def _floyd_offsets(deg: jax.Array, u: jax.Array, fanout: int) -> jax.Array:
   """Floyd's uniform sampling of `fanout` distinct offsets from [0, deg).
 
@@ -84,6 +95,9 @@ def sample_neighbors(
   assert fanout > 0, 'fanout must be a static positive int'
   seeds = seeds.astype(indptr.dtype)
   num_edges = indices.shape[0]
+  if num_edges == 0:  # legitimately empty (e.g. a rare-etype partition)
+    return _empty_output(seeds.shape[0], fanout, indices, edge_ids,
+                         indptr)
   start = jnp.take(indptr, seeds, mode='clip')
   end = jnp.take(indptr, seeds + 1, mode='clip')
   deg = (end - start).astype(jnp.int32)
@@ -129,6 +143,9 @@ def sample_full_neighbors(
   assert max_degree > 0
   seeds = seeds.astype(indptr.dtype)
   num_edges = indices.shape[0]
+  if num_edges == 0:
+    return _empty_output(seeds.shape[0], max_degree, indices, edge_ids,
+                         indptr)
   start = jnp.take(indptr, seeds, mode='clip')
   end = jnp.take(indptr, seeds + 1, mode='clip')
   deg = (end - start).astype(jnp.int32)
@@ -169,6 +186,9 @@ def sample_neighbors_weighted(
       'max_degree to at least the fanout')
   seeds = seeds.astype(indptr.dtype)
   num_edges = indices.shape[0]
+  if num_edges == 0:
+    return _empty_output(seeds.shape[0], fanout, indices, edge_ids,
+                         indptr)
   start = jnp.take(indptr, seeds, mode='clip')
   end = jnp.take(indptr, seeds + 1, mode='clip')
   deg = (end - start).astype(jnp.int32)
