@@ -46,7 +46,8 @@ class DistLinkNeighborLoader:
                shuffle: bool = False,
                drop_last: bool = False,
                seed: Optional[int] = None,
-               rng: Optional[np.random.Generator] = None):
+               rng: Optional[np.random.Generator] = None,
+               edge_feature: Optional[DistFeature] = None):
     self.g = dist_graph
     self.n_dev = dist_graph.mesh.shape[dist_graph.axis]
     self.edges = [as_numpy(e).astype(np.int64)
@@ -66,8 +67,10 @@ class DistLinkNeighborLoader:
     else:
       self.seeds_per_device = 2 * self.batch_size
     self.num_neg = num_neg
-    self.sampler = DistNeighborSampler(dist_graph, num_neighbors,
-                                       seed=seed)
+    self.sampler = DistNeighborSampler(
+        dist_graph, num_neighbors,
+        with_edge=edge_feature is not None, seed=seed)
+    self.edge_feature = edge_feature
     self._strict_neg = None
     if self.neg_sampling and self.neg_sampling.strict and num_neg:
       from .dist_negative import DistRandomNegativeSampler
@@ -178,5 +181,11 @@ class DistLinkNeighborLoader:
                  < out['node_count'][:, None]).reshape(-1)
         x = self.feature.lookup(jnp.maximum(node, 0), valid)
         out['x'] = x.reshape(out['node'].shape + (-1,))
+      if self.edge_feature is not None and 'edge' in out:
+        import jax.numpy as jnp
+        eids = out['edge'].reshape(-1)
+        ea = self.edge_feature.lookup(jnp.maximum(eids, 0),
+                                      out['edge_mask'].reshape(-1))
+        out['edge_attr'] = ea.reshape(out['edge'].shape + (-1,))
       out['n_pos'] = n_pos
       yield out

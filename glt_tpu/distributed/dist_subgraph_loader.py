@@ -31,7 +31,8 @@ class DistSubGraphLoader:
                shuffle: bool = False,
                drop_last: bool = False,
                seed: Optional[int] = None,
-               rng: Optional[np.random.Generator] = None):
+               rng: Optional[np.random.Generator] = None,
+               edge_feature: Optional[DistFeature] = None):
     self.g = dist_graph
     self.n_dev = dist_graph.mesh.shape[dist_graph.axis]
     self.seeds = [as_numpy(s).astype(np.int64)
@@ -48,6 +49,7 @@ class DistSubGraphLoader:
     self._extract = DistNeighborSampler(
         dist_graph, [self.max_degree], with_edge=True, seed=seed)
     self.feature = dist_feature
+    self.edge_feature = edge_feature
     self.batch_size = int(batch_size)
     self.shuffle = shuffle
     self.drop_last = drop_last
@@ -84,6 +86,16 @@ class DistSubGraphLoader:
       cols = np.asarray(ex['col'])
       masks = np.asarray(ex['edge_mask'])
       eids = np.asarray(ex['edge'])
+      all_ea = None
+      if self.edge_feature is not None:
+        import jax.numpy as jnp
+        # ONE static-shape whole-mesh lookup over the padded [P, E]
+        # slot grid (keeps DistFeature's compile-once contract); the
+        # ragged induced lists below slice it host-side
+        ea = self.edge_feature.lookup(
+            jnp.maximum(jnp.asarray(eids.reshape(-1)), 0),
+            jnp.asarray(masks.reshape(-1)))
+        all_ea = np.asarray(ea).reshape(eids.shape + (-1,))
       induced = []
       for p in range(self.n_dev):
         ok = masks[p] & (rows[p] >= 0) & (cols[p] >= 0) \
@@ -92,7 +104,10 @@ class DistSubGraphLoader:
         r = rows[p][ok]
         c = cols[p][ok]
         _, first = np.unique(e, return_index=True)
-        induced.append(dict(rows=r[first], cols=c[first], eids=e[first]))
+        item = dict(rows=r[first], cols=c[first], eids=e[first])
+        if all_ea is not None:  # uniform schema, even when empty
+          item['edge_attr'] = all_ea[p][ok][first]
+        induced.append(item)
       out['induced'] = induced
       if self.feature is not None:
         import jax.numpy as jnp

@@ -731,3 +731,46 @@ def test_dist_hetero_train_step_weighted(tmp_path_factory, mesh):
                     np.arange(N_PARTS * 2).reshape(N_PARTS, 2) % ni,
                     np.full(N_PARTS, 2), jax.random.key(1))
   assert np.isfinite(np.asarray(jax.block_until_ready(loss))).all()
+
+
+def test_dist_link_loader_edge_features(mesh, part_dir_ef,
+                                        dist_datasets_ef):
+  from glt_tpu.distributed import DistLinkNeighborLoader
+  from glt_tpu.sampler import NegativeSampling
+  dg = DistGraph.from_dataset_partitions(mesh, part_dir_ef)
+  edf = DistFeature.from_dist_datasets(mesh, dist_datasets_ef,
+                                       kind='edge')
+  pools = []
+  for p in range(N_PARTS):
+    owned = np.nonzero(np.asarray(dg.node_pb) == p)[0]
+    src = np.repeat(owned, 2)
+    dst = np.stack([(owned + 1) % N_NODES, (owned + 2) % N_NODES],
+                   1).reshape(-1)
+    pools.append(np.stack([src, dst]))
+  loader = DistLinkNeighborLoader(
+      dg, [2], pools, neg_sampling=NegativeSampling('binary', amount=1),
+      batch_size=4, seed=0, edge_feature=edf)
+  b = next(iter(loader))
+  em = np.asarray(b['edge_mask'])
+  np.testing.assert_allclose(np.asarray(b['edge_attr'])[em][:, 0],
+                             np.asarray(b['edge'])[em])
+
+
+def test_dist_subgraph_loader_edge_features(mesh, part_dir_ef,
+                                            dist_datasets_ef):
+  from glt_tpu.distributed import DistSubGraphLoader
+  dg = DistGraph.from_dataset_partitions(mesh, part_dir_ef)
+  edf = DistFeature.from_dist_datasets(mesh, dist_datasets_ef,
+                                       kind='edge')
+  loader = DistSubGraphLoader(
+      dg, num_hops=1,
+      input_nodes_per_device=[np.arange(p * 5, p * 5 + 4)
+                              for p in range(N_PARTS)],
+      batch_size=4, seed=0, edge_feature=edf)
+  b = next(iter(loader))
+  saw = 0
+  for item in b['induced']:
+    if item['eids'].shape[0]:
+      np.testing.assert_allclose(item['edge_attr'][:, 0], item['eids'])
+      saw += item['eids'].shape[0]
+  assert saw > 0
