@@ -114,6 +114,16 @@ class DistFeature:
 
   # -- builders ----------------------------------------------------------
 
+  def collate_edge_attr(self, out: dict) -> None:
+    """Attach ``out['edge_attr']`` gathered for the sampler output's
+    padded [P, E] eids grid (one static-shape whole-mesh lookup —
+    the shared collate used by every dist loader)."""
+    import jax.numpy as jnp
+    eids = out['edge']
+    ea = self.lookup(jnp.maximum(jnp.asarray(eids).reshape(-1), 0),
+                     jnp.asarray(out['edge_mask']).reshape(-1))
+    out['edge_attr'] = ea.reshape(tuple(eids.shape) + (-1,))
+
   @classmethod
   def from_dist_datasets(cls, mesh: Mesh, datasets, ntype=None,
                          axis: str = 'data', dtype=None,

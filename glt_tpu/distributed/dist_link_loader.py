@@ -47,7 +47,8 @@ class DistLinkNeighborLoader:
                drop_last: bool = False,
                seed: Optional[int] = None,
                rng: Optional[np.random.Generator] = None,
-               edge_feature: Optional[DistFeature] = None):
+               edge_feature: Optional[DistFeature] = None,
+               with_edge: bool = False):
     self.g = dist_graph
     self.n_dev = dist_graph.mesh.shape[dist_graph.axis]
     self.edges = [as_numpy(e).astype(np.int64)
@@ -69,7 +70,7 @@ class DistLinkNeighborLoader:
     self.num_neg = num_neg
     self.sampler = DistNeighborSampler(
         dist_graph, num_neighbors,
-        with_edge=edge_feature is not None, seed=seed)
+        with_edge=with_edge or edge_feature is not None, seed=seed)
     self.edge_feature = edge_feature
     self._strict_neg = None
     if self.neg_sampling and self.neg_sampling.strict and num_neg:
@@ -182,10 +183,6 @@ class DistLinkNeighborLoader:
         x = self.feature.lookup(jnp.maximum(node, 0), valid)
         out['x'] = x.reshape(out['node'].shape + (-1,))
       if self.edge_feature is not None and 'edge' in out:
-        import jax.numpy as jnp
-        eids = out['edge'].reshape(-1)
-        ea = self.edge_feature.lookup(jnp.maximum(eids, 0),
-                                      out['edge_mask'].reshape(-1))
-        out['edge_attr'] = ea.reshape(out['edge'].shape + (-1,))
+        self.edge_feature.collate_edge_attr(out)
       out['n_pos'] = n_pos
       yield out

@@ -98,11 +98,7 @@ class DistNeighborLoader:
         x = self.feature.lookup(jnp.maximum(node, 0), valid)
         out['x'] = x.reshape(out['node'].shape + (-1,))
       if self.edge_feature is not None and 'edge' in out:
-        import jax.numpy as jnp
-        eids = out['edge'].reshape(-1)
-        ea = self.edge_feature.lookup(jnp.maximum(eids, 0),
-                                      out['edge_mask'].reshape(-1))
-        out['edge_attr'] = ea.reshape(out['edge'].shape + (-1,))
+        self.edge_feature.collate_edge_attr(out)
       if self.labels is not None:
         out['y'] = self.labels[np.maximum(np.asarray(out['batch']), 0)]
       out['n_valid'] = n_valid

@@ -88,14 +88,11 @@ class DistSubGraphLoader:
       eids = np.asarray(ex['edge'])
       all_ea = None
       if self.edge_feature is not None:
-        import jax.numpy as jnp
         # ONE static-shape whole-mesh lookup over the padded [P, E]
         # slot grid (keeps DistFeature's compile-once contract); the
         # ragged induced lists below slice it host-side
-        ea = self.edge_feature.lookup(
-            jnp.maximum(jnp.asarray(eids.reshape(-1)), 0),
-            jnp.asarray(masks.reshape(-1)))
-        all_ea = np.asarray(ea).reshape(eids.shape + (-1,))
+        self.edge_feature.collate_edge_attr(ex)
+        all_ea = np.asarray(ex['edge_attr'])
       induced = []
       for p in range(self.n_dev):
         ok = masks[p] & (rows[p] >= 0) & (cols[p] >= 0) \

@@ -199,9 +199,11 @@ def test_server_client_mode():
     np.testing.assert_allclose(out['feats'][:, 0], [3, 7])
     assert request_server(0, 'get_tensor_size') == (40, 4)
 
-    # remote sampling: server 0 serves seeds 0..19, server 1 20..39
+    # remote sampling: server 0 serves seeds 0..19, server 1 20..39;
+    # with_edge also rides the remote path (efeats collated server-side)
     loader = RemoteNeighborLoader(
         [2], [np.arange(20), np.arange(20, 40)], batch_size=5,
+        with_edge=True,
         worker_options=RemoteDistSamplingWorkerOptions(
             server_rank=[0, 1], prefetch_size=2),
         seed=1)
@@ -211,6 +213,11 @@ def test_server_client_mode():
       count += 1
       nv = b.metadata['n_valid']
       seen.update(np.asarray(b.batch)[:nv].tolist())
+      # ring fixture value-encodes edge features: row e == [e]*4
+      em = np.asarray(b.edge_mask)
+      assert b.edge is not None and b.edge_attr is not None
+      np.testing.assert_allclose(np.asarray(b.edge_attr)[em][:, 0],
+                                 np.asarray(b.edge)[em])
     assert count == 8  # 4 batches per server
     assert seen == set(range(40))
     # second epoch
