@@ -118,7 +118,6 @@ class DistFeature:
     """Attach ``out['edge_attr']`` gathered for the sampler output's
     padded [P, E] eids grid (one static-shape whole-mesh lookup —
     the shared collate used by every dist loader)."""
-    import jax.numpy as jnp
     eids = out['edge']
     ea = self.lookup(jnp.maximum(jnp.asarray(eids).reshape(-1), 0),
                      jnp.asarray(out['edge_mask']).reshape(-1))
@@ -158,11 +157,18 @@ class DistFeature:
 
 def dist_feature_from_partitions_multihost(mesh, root_dir: str,
                                            ntype=None, axis: str = 'data',
-                                           dtype=None) -> DistFeature:
+                                           dtype=None,
+                                           kind: str = 'node'
+                                           ) -> DistFeature:
   """Multi-host DistFeature: each process loads ONLY its partitions'
   feature blocks (cache-concat + PB rewrite included) and contributes
   them via process-local assembly; padding agreed with an allgather.
-  Counterpart of dist_graph_from_partitions_multihost."""
+  Counterpart of dist_graph_from_partitions_multihost.
+
+  ``kind='edge'`` builds the edge-feature store from the partitions'
+  efeat blocks + edge partition books (``ntype`` then selects the edge
+  type for hetero trees)."""
+  assert kind in ('node', 'edge')
   import jax
   import jax.numpy as jnp
   from ..parallel.multihost import global_from_local
@@ -182,9 +188,18 @@ def dist_feature_from_partitions_multihost(mesh, root_dir: str,
   feat_dim = None
   local_max_rows = 0
   for p in mine:
-    _, _, nfeat, _, node_pb, _ = load_partition(root_dir, p)
-    f = nfeat[ntype] if ntype is not None else nfeat
-    pb = node_pb[ntype] if ntype is not None else node_pb
+    _, _, nfeat, efeat, node_pb, edge_pb = load_partition(root_dir, p)
+    src, books = ((efeat, edge_pb) if kind == 'edge'
+                  else (nfeat, node_pb))
+    f = src[ntype] if isinstance(src, dict) and ntype is not None else src
+    pb = (books[ntype] if isinstance(books, dict) and ntype is not None
+          else books)
+    if f is None:
+      raise ValueError(
+          f'partition {p} of {root_dir} holds no {kind} features '
+          f'(ntype={ntype!r}); partition with '
+          f'{"edge_feat" if kind == "edge" else "node_feat"} to use '
+          f'kind={kind!r}')
     feats, ids, id2index, pb2 = cat_feature_cache(p, f, pb)
     blocks[p] = (feats, id2index, pb2)
     num_ids = max(num_ids, pb2.table.shape[0])

@@ -55,6 +55,13 @@ def main():
     local = np.asarray(shard.data)
     expect = ids[shard.index[0]]
     np.testing.assert_allclose(local[:, 0], expect)
+  # edge-feature store over the same multihost tree (value-encoded)
+  edf = dist_feature_from_partitions_multihost(mesh, root, kind='edge')
+  eids = np.arange(4 * 8) % 80
+  ex = edf.lookup(jnp.asarray(eids))
+  for shard in ex.addressable_shards:
+    local = np.asarray(shard.data)
+    np.testing.assert_allclose(local[:, 0], eids[shard.index[0]])
   print(f'RANK{rank}_OK', flush=True)
 
 

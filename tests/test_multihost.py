@@ -27,9 +27,10 @@ def _free_port():
 def test_two_process_distributed_sampling(tmp_path):
   rows, cols, eids = ring_edges(40)
   feats = np.tile(np.arange(40, dtype=np.float32)[:, None], (1, 4))
+  efeats = np.tile(np.arange(80, dtype=np.float32)[:, None], (1, 3))
   RandomPartitioner(str(tmp_path), num_parts=4, num_nodes=40,
                     edge_index=np.stack([rows, cols]),
-                    node_feat=feats).partition()
+                    node_feat=feats, edge_feat=efeats).partition()
   port = _free_port()
   worker = os.path.join(os.path.dirname(__file__), 'multihost_worker.py')
   env = dict(os.environ)
