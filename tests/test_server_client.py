@@ -419,3 +419,29 @@ def test_mp_loader_dead_worker_times_out_cleanly():
         next(it)
   finally:
     loader.shutdown()
+
+
+def test_mp_loader_worker_respawn_heals_next_epoch():
+  """Self-healing across epochs: a worker killed between epochs is
+  respawned by produce_all, so the next epoch is complete again
+  (exceeds the reference, which only times out)."""
+  from glt_tpu.distributed import MpDistSamplingWorkerOptions, \
+      MpNeighborLoader
+  loader = MpNeighborLoader(
+      build_ring_dataset, [2], input_nodes=np.arange(40),
+      batch_size=8, collect_features=False,
+      worker_options=MpDistSamplingWorkerOptions(num_workers=2),
+      seed=0)
+  try:
+    assert len(list(loader)) == 6        # healthy epoch: 3 per worker
+    for w in loader.producer._workers:   # kill everything between epochs
+      w.terminate()
+      w.join(timeout=10)
+    batches = list(loader)               # produce_all respawns first
+    assert len(batches) == 6, len(batches)
+    seen = set()
+    for b in batches:
+      seen.update(np.asarray(b.batch)[:b.metadata['n_valid']].tolist())
+    assert seen == set(range(40))
+  finally:
+    loader.shutdown()
