@@ -143,6 +143,8 @@ class DistMpSamplingProducer:
     self._ctx = mp.get_context('spawn')
     self._task_queues = []
     self._workers: List[mp.Process] = []
+    self._respawns: dict = {}
+    self.max_respawns_per_rank = 3
 
   def _spawn(self, rank: int):
     splits = np.array_split(self.seeds, self.num_workers)
@@ -166,10 +168,27 @@ class DistMpSamplingProducer:
     worker that died is relaunched with its own seed slice so the NEXT
     epoch is complete again. Returns the number respawned. A mid-epoch
     death still surfaces as a recv timeout for that epoch — the healing
-    boundary is the epoch, where re-arming cannot duplicate batches."""
+    boundary is the epoch, where re-arming cannot duplicate batches.
+
+    Each respawn is logged with the dead worker's exit code, and a
+    persistent crash loop (a rank respawned more than
+    ``max_respawns_per_rank`` times) raises instead of silently eating
+    an rpc timeout per epoch."""
+    import logging
     n = 0
     for rank, w in enumerate(self._workers):
       if not w.is_alive():
+        self._respawns[rank] = self._respawns.get(rank, 0) + 1
+        logging.getLogger(__name__).warning(
+            'sampling worker %d died (exitcode=%s); respawning '
+            '(%d/%d)', rank, w.exitcode, self._respawns[rank],
+            self.max_respawns_per_rank)
+        if self._respawns[rank] > self.max_respawns_per_rank:
+          raise RuntimeError(
+              f'sampling worker {rank} crash-looped '
+              f'{self._respawns[rank]} times (last exitcode '
+              f'{w.exitcode}); check the dataset_builder in the '
+              'subprocess')
         tq, w2 = self._spawn(rank)
         self._task_queues[rank] = tq
         self._workers[rank] = w2
