@@ -1,0 +1,116 @@
+"""Primitive-op microbenchmarks at sampler shapes — decides the dedup
+formulation (scatter-table vs sort-based) and quantifies the gather
+floor on the actual backend.
+
+Each row: steady-state ms for one op at the bench.py hot-loop shapes
+(frontier 153.6k, slots 768k, table 2.45M, edges 62M). Emits one JSON
+line; ``GLT_BENCH_PLATFORM=cpu`` forces the CPU backend.
+"""
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), '.jax_cache')
+
+N = 2_450_000
+E = 62_000_000
+M = 768_000          # hop-2 slot count
+F = 153_600          # hop-2 frontier width
+
+
+def timed(fn, *args, iters=20, warmup=3, donate_idx=None):
+  import jax
+  out = None
+  state = list(args)
+  for _ in range(warmup):
+    out = fn(*state)
+    if donate_idx is not None:
+      state[donate_idx] = out[donate_idx] if isinstance(out, tuple) else out
+  jax.block_until_ready(out)
+  t0 = time.time()
+  for _ in range(iters):
+    out = fn(*state)
+    if donate_idx is not None:
+      state[donate_idx] = out[donate_idx] if isinstance(out, tuple) else out
+  jax.block_until_ready(out)
+  return (time.time() - t0) / iters * 1e3
+
+
+def main():
+  import jax
+  if os.environ.get('GLT_BENCH_PLATFORM'):
+    jax.config.update('jax_platforms', os.environ['GLT_BENCH_PLATFORM'])
+  jax.config.update('jax_compilation_cache_dir', _CACHE_DIR)
+  jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
+  import jax.numpy as jnp
+
+  rng = np.random.default_rng(0)
+  res = {}
+
+  def rec(name, ms):
+    res[name] = round(ms, 3)
+    print(f'# {name}: {ms:.3f} ms', file=sys.stderr, flush=True)
+
+  big = jnp.asarray(rng.integers(0, N, E, dtype=np.int64).astype(np.int32))
+  table = jnp.full((N + 1,), -1, jnp.int32)
+  idx_m = jnp.asarray(rng.integers(0, N, M).astype(np.int32))
+  idx_f = jnp.asarray(rng.integers(0, E, F).astype(np.int32))
+  idx_me = jnp.asarray(rng.integers(0, E, M).astype(np.int32))
+  vals_m = jnp.asarray(rng.integers(0, 1 << 30, M).astype(np.int32))
+
+  # -- gathers ---------------------------------------------------------
+  rec('gather_768k_from_62M',
+      timed(jax.jit(lambda i: jnp.take(big, i, mode='clip')), idx_me))
+  rec('gather_768k_from_2.45M',
+      timed(jax.jit(lambda i: jnp.take(table, i, mode='clip')), idx_m))
+  rec('gather_153k_from_62M',
+      timed(jax.jit(lambda i: jnp.take(big, i, mode='clip')), idx_f))
+
+  # -- scatters into the [N+1] table -----------------------------------
+  @functools.partial(jax.jit, donate_argnums=(0,))
+  def scat_set(t, i, v):
+    return t.at[i].set(v)
+
+  @functools.partial(jax.jit, donate_argnums=(0,))
+  def scat_min(t, i, v):
+    return t.at[i].min(v)
+
+  rec('scatter_set_768k_into_2.45M',
+      timed(scat_set, table, idx_m, vals_m, donate_idx=0))
+  rec('scatter_min_768k_into_2.45M',
+      timed(scat_min, jnp.full((N + 1,), 2**31 - 1, jnp.int32), idx_m,
+            vals_m, donate_idx=0))
+
+  # -- sorts at dedup shapes -------------------------------------------
+  rec('sort_768k_i32', timed(jax.jit(jnp.sort), vals_m))
+  rec('argsort_768k_i32', timed(jax.jit(jnp.argsort), vals_m))
+  key64 = (idx_m.astype(jnp.int64) << 20) | jnp.arange(M, dtype=jnp.int64)
+  rec('sort_768k_i64_packed', timed(jax.jit(jnp.sort), key64))
+  two = jax.jit(lambda k, v: jax.lax.sort([k, v], num_keys=1))
+  rec('sortpair_768k_i32', timed(two, idx_m, vals_m))
+
+  # -- misc hot-loop ops -----------------------------------------------
+  rec('cumsum_768k', timed(jax.jit(lambda v: jnp.cumsum(v)), vals_m))
+  rec('top_k_768k_k5',
+      timed(jax.jit(lambda v: jax.lax.top_k(v, 5)[0]),
+            vals_m.reshape(F, 5).astype(jnp.float32)))
+  rec('uniform_15x153k',
+      timed(jax.jit(lambda k: jax.random.uniform(k, (15, F))),
+            jax.random.key(1)))
+
+  dev = jax.devices()[0]
+  print(json.dumps({'metric': 'prim_ms', 'backend': dev.platform,
+                    'shapes': {'N': N, 'E': E, 'M': M, 'F': F},
+                    'ops': res}))
+
+
+if __name__ == '__main__':
+  main()

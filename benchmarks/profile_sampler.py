@@ -74,6 +74,12 @@ def main():
   from glt_tpu.ops.unique import dense_assign, dense_init, \
       dense_make_tables, dense_reset
 
+  def record(stages, name, secs):
+    # incremental output: the axon tunnel can drop mid-run, and stage
+    # timings are too expensive to lose with a print-at-the-end design
+    stages[name] = secs
+    print(f'# {name}: {secs * 1e3:.3f} ms', file=_sys.stderr, flush=True)
+
   rng = np.random.default_rng(0)
   src = rng.integers(0, NUM_NODES, NUM_EDGES, dtype=np.int64)
   dst = (rng.random(NUM_EDGES) ** 2 * NUM_NODES).astype(np.int64) \
@@ -99,9 +105,9 @@ def main():
       out = sample_neighbors(indptr, indices, fr, _k, key, seed_mask=m)
       return out.nbrs, out.mask
 
-    stages[f'one_hop_h{h}'] = _time_fn(
+    record(stages, f'one_hop_h{h}', _time_fn(
         lambda fr, m: hop_only(fr, m, key), (frontier, mask),
-        iters=args.iters)
+        iters=args.iters))
 
     nbrs = np.asarray(hop_only(frontier, mask, key)[0]).reshape(-1)
     nmask = np.asarray(hop_only(frontier, mask, key)[1]).reshape(-1)
@@ -115,10 +121,10 @@ def main():
       return labels, table, scratch
 
     table, scratch = dense_make_tables(NUM_NODES)
-    stages[f'assign_h{h}'] = _time_fn(
+    record(stages, f'assign_h{h}', _time_fn(
         assign_only,
         (jnp.asarray(nbrs), jnp.asarray(nmask), table, scratch),
-        iters=args.iters, donate_state=True)
+        iters=args.iters, donate_state=True))
     width *= k
 
   # composed program (bench.py's work unit)
@@ -133,8 +139,8 @@ def main():
 
   table, scratch = dense_make_tables(NUM_NODES)
   seeds = jnp.asarray(rng.integers(0, NUM_NODES, BATCH).astype(np.int32))
-  stages['composed'] = _time_fn(composed, (seeds, key, table, scratch),
-                                iters=args.iters, donate_state=True)
+  record(stages, 'composed', _time_fn(composed, (seeds, key, table, scratch),
+                                      iters=args.iters, donate_state=True))
 
   scan = max(int(os.environ.get('GLT_BENCH_SCAN', '4')), 1)
 
@@ -148,9 +154,9 @@ def main():
   seeds2 = jnp.asarray(
       rng.integers(0, NUM_NODES, (scan, BATCH)).astype(np.int32))
   table, scratch = dense_make_tables(NUM_NODES)
-  stages['composed_scan_per_batch'] = _time_fn(
+  record(stages, 'composed_scan_per_batch', _time_fn(
       composed_scan, (seeds2, key, table, scratch),
-      iters=args.iters, donate_state=True) / scan
+      iters=args.iters, donate_state=True) / scan)
 
   if args.trace:
     table, scratch = dense_make_tables(NUM_NODES)
