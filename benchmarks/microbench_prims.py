@@ -92,8 +92,6 @@ def main():
   # -- sorts at dedup shapes -------------------------------------------
   rec('sort_768k_i32', timed(jax.jit(jnp.sort), vals_m))
   rec('argsort_768k_i32', timed(jax.jit(jnp.argsort), vals_m))
-  key64 = (idx_m.astype(jnp.int64) << 20) | jnp.arange(M, dtype=jnp.int64)
-  rec('sort_768k_i64_packed', timed(jax.jit(jnp.sort), key64))
   two = jax.jit(lambda k, v: jax.lax.sort([k, v], num_keys=1))
   rec('sortpair_768k_i32', timed(two, idx_m, vals_m))
 

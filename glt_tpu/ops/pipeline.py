@@ -8,15 +8,35 @@ in the NeighborSampler docstring.
 """
 from __future__ import annotations
 
+import os
 from typing import Callable, Dict, List, Sequence
 
 import jax
 import jax.numpy as jnp
 
 from .sample import NeighborOutput
-from .unique import dense_assign, dense_init, dense_reset
+from .unique import (dense_assign, dense_init, dense_reset,
+                     sorted_hop_dedup, sorted_nodes_by_label)
 
 OneHopFn = Callable[[jax.Array, int, jax.Array, jax.Array], NeighborOutput]
+
+
+def dedup_engine() -> str:
+  """Which inducer backs the HOMO hop loop (:func:`multihop_sample`):
+  'table' (dense scatter tables, fast where random access is cheap —
+  CPU) or 'sort' (sort-merge, fast where sorts are the vectorized
+  primitive — TPU; see ops/unique.py). GLT_DEDUP=table|sort|auto
+  overrides; auto picks by backend. The hetero loop
+  (:func:`multihop_sample_hetero`) currently always uses the table
+  engine: its per-etype slicing assumes slot order, which the sorted
+  engine's permuted layout does not provide (port tracked in
+  benchmarks/PERF_PLAN.md)."""
+  mode = os.environ.get('GLT_DEDUP', 'auto')
+  if mode not in ('auto', 'sort', 'table'):
+    raise ValueError(f'GLT_DEDUP={mode!r}: expected auto|sort|table')
+  if mode == 'auto':
+    return 'sort' if jax.default_backend() == 'tpu' else 'table'
+  return mode
 
 
 def sample_budget(batch_size: int, fanouts: Sequence[int]) -> int:
@@ -51,6 +71,10 @@ def multihop_sample(one_hop: OneHopFn,
   ``one_hop(frontier_ids, fanout, key, mask)`` performs one sampling hop.
   Tables are returned reset, ready for the next batch.
   """
+  if dedup_engine() == 'sort':
+    out = _multihop_sample_sorted(one_hop, seeds, n_valid, fanouts, key,
+                                  with_edge=with_edge)
+    return out, table, scratch
   batch_size = seeds.shape[0]
   budget = sample_budget(batch_size, fanouts)
   state = dense_init(table, scratch, budget)
@@ -101,6 +125,78 @@ def multihop_sample(one_hop: OneHopFn,
   if with_edge:
     out_dict['edge'] = jnp.concatenate(eid_list)
   return out_dict, table, scratch
+
+
+def _multihop_sample_sorted(one_hop: OneHopFn,
+                            seeds: jax.Array,
+                            n_valid: jax.Array,
+                            fanouts: Sequence[int],
+                            key: jax.Array,
+                            with_edge: bool = False) -> Dict[str, jax.Array]:
+  """The hop loop on the sort-merge inducer (ops/unique.py
+  sorted_hop_dedup): no [N]-sized tables, no scatters, no gathers — two
+  multi-operand sorts + prefix scans per hop. Labels, node list, batch,
+  seed_labels and per-hop counts match the table path EXACTLY; edge
+  tuples (row/col/mask/eid) are the same multiset per hop block but in a
+  permuted order within the block (consumers are order-insensitive; the
+  parity test canonicalizes)."""
+  batch_size = seeds.shape[0]
+  budget = sample_budget(batch_size, fanouts)
+  seed_mask = jnp.arange(batch_size) < n_valid
+
+  u_ids = jnp.zeros((0,), jnp.int32)
+  u_labs = jnp.zeros((0,), jnp.int32)
+  count = jnp.zeros((), jnp.int32)
+  d = sorted_hop_dedup(u_ids, u_labs, count, seeds, seed_mask,
+                       jnp.full((batch_size,), -1, jnp.int32))
+  # contract: seed_labels in seed-slot order (tiny unsort over [batch])
+  seed_labels = jax.lax.sort([d['pos3'], d['labels3']], num_keys=1)[1]
+  seed_labels = jnp.where(seed_mask, seed_labels, -1)
+  seed_count = d['count2']
+  u_ids, u_labs, count = d['u_ids2'], d['u_labs2'], d['count2']
+  frontier_ids = d['ids3']
+  frontier_labels = d['labels3']
+  frontier_mask = d['new_head3']
+
+  rows_parent, cols_child, emasks, eid_list = [], [], [], []
+  hop_node_counts = [seed_count]
+  hop_edge_counts = []
+  for fanout in fanouts:
+    width = abs(fanout)
+    key, sub = jax.random.split(key)
+    out = one_hop(frontier_ids, fanout, sub, frontier_mask)
+    rows_flat = jnp.repeat(frontier_labels, width)
+    eflat = out.eids.reshape(-1) if with_edge else None
+    d = sorted_hop_dedup(u_ids, u_labs, count, out.nbrs.reshape(-1),
+                         out.mask.reshape(-1), rows_flat, eflat)
+    u_ids, u_labs, count = d['u_ids2'], d['u_labs2'], d['count2']
+    rows_parent.append(d['rows3'])
+    cols_child.append(d['labels3'])
+    emasks.append(d['mask3'])
+    if with_edge:
+      eid_list.append(d['eids3'])
+    hop_node_counts.append(d['new_count'])
+    hop_edge_counts.append(out.mask.sum().astype(jnp.int32))
+    frontier_ids = d['ids3']
+    frontier_labels = d['labels3']
+    frontier_mask = d['new_head3']
+
+  nodes = sorted_nodes_by_label(u_ids, u_labs, count, budget)
+  out_dict = dict(
+      node=nodes,
+      node_count=count,
+      row=jnp.concatenate(cols_child),
+      col=jnp.concatenate(rows_parent),
+      edge_mask=jnp.concatenate(emasks),
+      batch=jax.lax.slice(nodes, (0,), (batch_size,)),
+      seed_labels=seed_labels,
+      seed_count=seed_count,
+      num_sampled_nodes=jnp.stack(hop_node_counts),
+      num_sampled_edges=jnp.stack(hop_edge_counts),
+  )
+  if with_edge:
+    out_dict['edge'] = jnp.concatenate(eid_list)
+  return out_dict
 
 
 def hetero_edge_capacities(caps, trav, num_neighbors, num_hops):

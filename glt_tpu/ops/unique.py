@@ -9,7 +9,7 @@ order runs by their first-occurrence position. All shapes static.
 """
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -182,6 +182,128 @@ def dense_assign(state: DenseInducerState, ids: jax.Array,
   scratch = scratch.at[safe].set(_BIG)
   return (DenseInducerState(table=table, scratch=scratch, nodes=nodes,
                             count=count), labels)
+
+
+# ---------------------------------------------------------------------------
+# Sort-merge inducer: the TPU fast path.
+#
+# Hardware measurement (benchmarks/microbench_prims.py, v5e): every random
+# access XLA:TPU emits — gather or scatter, any operand size — costs
+# ~7-16ns per OUTPUT ELEMENT, serialized; `lax.sort` by contrast runs
+# vectorized at ~3-4ns/element and multi-operand sorts carry payloads for
+# free. The dense-table inducer above spends ~7 random accesses per slot;
+# this engine spends ZERO — dedup/relabel/frontier-compaction are all
+# expressed as multi-operand sorts over the batch plus prefix scans, the
+# same trick as the reference's sort-free GPU hash table but inverted for
+# a machine whose fast primitive is the sort, not the atomic.
+# ---------------------------------------------------------------------------
+
+
+def _fill_forward(hd: jax.Array, *vals: jax.Array):
+  """Segmented fill-forward: out_k[i] = vals_k at the most recent j<=i
+  with hd[j]. Log-depth associative scan — no gathers, no scatters."""
+  def comb(a, b):
+    ah = a[0]
+    bh = b[0]
+    return (ah | bh,) + tuple(
+        jnp.where(bh, bv, av) for av, bv in zip(a[1:], b[1:]))
+  return jax.lax.associative_scan(comb, (hd,) + vals)[1:]
+
+
+def sorted_hop_dedup(
+    u_ids: jax.Array,    # [C] seen-set ids (any order, _BIG padding ok)
+    u_labs: jax.Array,   # [C] their labels
+    count: jax.Array,    # scalar int32: labels assigned so far
+    ids: jax.Array,      # [M] sampled ids for this hop (dups allowed)
+    valid: jax.Array,    # [M]
+    rows: jax.Array,     # [M] parent labels, carried through the sorts
+    eids: Optional[jax.Array] = None,  # [M] edge ids, carried if given
+):
+  """One hop of dedup/relabel with ZERO random-memory ops — two
+  multi-operand sorts plus prefix scans.
+
+  Labels are exact reference-inducer semantics: previously seen ids keep
+  their labels; new ids get ``count..count+n-1`` in first-occurrence
+  (slot) order. The returned per-element arrays are in a PERMUTED order
+  (appearance-grouped), not slot order — every array below is aligned to
+  the same permutation, so edge tuples stay consistent; within-hop edge
+  order is unspecified (hop blocks themselves stay separate).
+
+  Returns a dict with:
+    ids3 / labels3 / rows3 / mask3 / eids3 : [M] aligned per-element
+    new_head3 : [M] True at the first occurrence of each new id
+    pos3      : [M] original slot index of each element
+    u_ids2 / u_labs2 : [C+M] updated seen-set (append-form, not sorted)
+    count2 : scalar, new_count : scalar
+  """
+  c = u_ids.shape[0]
+  m = ids.shape[0]
+  big = _BIG
+  x = jnp.where(valid, ids.astype(jnp.int32), big)
+  cat_id = jnp.concatenate([u_ids, x])
+  cat_pos = jnp.concatenate([jnp.full((c,), -1, jnp.int32),
+                             jnp.arange(m, dtype=jnp.int32)])
+  cat_lab = jnp.concatenate([u_labs, jnp.full((m,), -1, jnp.int32)])
+  cat_row = jnp.concatenate([jnp.full((c,), -1, jnp.int32),
+                             rows.astype(jnp.int32)])
+  cat_msk = jnp.concatenate([jnp.zeros((c,), jnp.int32),
+                             valid.astype(jnp.int32)])
+  ops = [cat_id, cat_pos, cat_lab, cat_row, cat_msk]
+  if eids is not None:
+    ops.append(jnp.concatenate([jnp.full((c,), -1, eids.dtype), eids]))
+  # sort 1: (id, pos) — a seen-set entry (pos -1) heads its id-run
+  s = jax.lax.sort(ops, num_keys=2)
+  sid, spos, slab, srow, smsk = s[:5]
+  seid = s[5] if eids is not None else None
+
+  hd = jnp.concatenate([jnp.ones((1,), bool), sid[1:] != sid[:-1]])
+  hd = hd & (sid != big)
+  head_slab, head_spos = _fill_forward(hd, slab, spos)
+  is_new_run = (head_slab < 0) & (sid != big)    # run headed by a slot
+  u_lab = jnp.where(is_new_run | (sid == big), -1, head_slab)
+
+  # sort 2: (group key, pos). New runs group under their head's slot
+  # position (= appearance order); seen/invalid slots key by their own
+  # position; original seen-set entries are pushed to the back. All M
+  # slot elements therefore land in [:M].
+  is_slot = spos >= 0
+  gkey = jnp.where(is_slot, jnp.where(is_new_run, head_spos, spos), big)
+  ops2 = [gkey, spos, sid, u_lab, is_new_run.astype(jnp.int32), srow,
+          smsk]
+  if eids is not None:
+    ops2.append(seid)
+  s2 = jax.lax.sort(ops2, num_keys=2)
+  gkey2, pos3, ids3, ulab3, new3, rows3, msk3 = (a[:m] for a in s2[:7])
+  eids3 = s2[7][:m] if eids is not None else None
+  new3 = new3.astype(bool)
+
+  # the first element of each new group is its head (pos == group key);
+  # inclusive prefix count over appearance-ordered groups = label rank
+  new_head3 = new3 & (pos3 == gkey2)
+  from .scan import cumsum_i32
+  rank = cumsum_i32(new_head3.astype(jnp.int32))
+  labels3 = jnp.where(new3, count + rank - 1, ulab3)
+
+  new_count = rank[-1] if m > 0 else jnp.zeros((), jnp.int32)
+  # seen-set append: each new id exactly once (at its head element)
+  u_ids2 = jnp.concatenate([u_ids, jnp.where(new_head3, ids3, big)])
+  u_labs2 = jnp.concatenate([u_labs, jnp.where(new_head3, labels3,
+                                               big)])
+  return dict(ids3=ids3, labels3=labels3, rows3=rows3,
+              mask3=msk3.astype(bool), eids3=eids3, new_head3=new_head3,
+              pos3=pos3, u_ids2=u_ids2, u_labs2=u_labs2,
+              count2=count + new_count, new_count=new_count)
+
+
+def sorted_nodes_by_label(u_ids: jax.Array, u_labs: jax.Array,
+                          count: jax.Array, budget: int) -> jax.Array:
+  """Materialize the dense node list (position = label) from the
+  append-form seen-set with ONE sort by label; -1 padding past count."""
+  lab_key = jnp.where(u_labs < 0, _BIG, u_labs)
+  nodes = jax.lax.sort([lab_key, u_ids], num_keys=1)[1]
+  nodes = nodes[:budget] if nodes.shape[0] >= budget else jnp.pad(
+      nodes, (0, budget - nodes.shape[0]), constant_values=-1)
+  return jnp.where(jnp.arange(budget) < count, nodes, -1)
 
 
 def dense_reset(state: DenseInducerState):
