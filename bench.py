@@ -71,9 +71,8 @@ def run_worker():
   jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
   import jax.numpy as jnp
   from glt_tpu.data import Topology
-  from glt_tpu.ops.pipeline import multihop_sample
+  from glt_tpu.ops.pipeline import make_dedup_tables, multihop_sample
   from glt_tpu.ops.sample import sample_neighbors
-  from glt_tpu.ops.unique import dense_make_tables
 
   dev = jax.devices()[0]
   print(f'# backend: {dev.platform} ({dev.device_kind})', file=sys.stderr)
@@ -96,6 +95,20 @@ def run_worker():
   import functools
   scan = max(int(os.environ.get('GLT_BENCH_SCAN', '4')), 1)
 
+  def checksum(out):
+    # Fold EVERY output into the returned scalars so no stage of the
+    # pipeline is dead code: without this, XLA correctly deletes the
+    # last hop's neighbor gather + dedup (their values feed nothing) and
+    # the bench measures a program no real consumer runs. The reference
+    # bench materializes full sample results (bench_sampler.py); cheap
+    # vectorized reductions are the static-shape equivalent.
+    acc = jnp.zeros((), jnp.int32)
+    for k in ('node', 'row', 'col', 'batch', 'seed_labels'):
+      acc += out[k].sum(dtype=jnp.int32)
+    acc += out['edge_mask'].sum(dtype=jnp.int32)
+    acc += out['node_count'].sum(dtype=jnp.int32)
+    return acc
+
   @functools.partial(jax.jit, donate_argnums=(2, 3))
   def sample_batch(seeds, key, table, scratch):
     if scan > 1:
@@ -103,29 +116,36 @@ def run_worker():
       outs, table, scratch = multihop_sample_many(
           one_hop, seeds, jnp.full(scan, BATCH, jnp.int32), FANOUT, key,
           table, scratch)
-      return outs['num_sampled_edges'].sum(), table, scratch
+      return (outs['num_sampled_edges'].sum(), checksum(outs), table,
+              scratch)
     out, table, scratch = multihop_sample(
         one_hop, seeds[0], jnp.asarray(BATCH), FANOUT, key, table,
         scratch)
-    return out['num_sampled_edges'].sum(), table, scratch
+    return out['num_sampled_edges'].sum(), checksum(out), table, scratch
 
-  table, scratch = dense_make_tables(NUM_NODES)
+  table, scratch = make_dedup_tables(NUM_NODES)
   seed_pool = rng.integers(0, NUM_NODES, (ITERS + WARMUP, scan, BATCH))
-  keys = jax.random.split(jax.random.key(0), ITERS + WARMUP)
+  # GLT_PRNG=rbg swaps threefry for the XLA RngBitGenerator-backed
+  # implementation (typed keys propagate the impl through every split
+  # inside the pipeline); counter-based threefry stays the default for
+  # reproducibility across backends
+  impl = os.environ.get('GLT_PRNG') or None
+  keys = jax.random.split(jax.random.key(0, impl=impl), ITERS + WARMUP)
 
   edges = None
   for i in range(WARMUP):
-    edges, table, scratch = sample_batch(
+    edges, sig, table, scratch = sample_batch(
         jnp.asarray(seed_pool[i], jnp.int32), keys[i], table, scratch)
-  jax.block_until_ready(edges)
+  jax.block_until_ready((edges, sig))
 
-  edge_counts = []
+  edge_counts, sigs = [], []
   t0 = time.time()
   for i in range(WARMUP, WARMUP + ITERS):
-    edges, table, scratch = sample_batch(
+    edges, sig, table, scratch = sample_batch(
         jnp.asarray(seed_pool[i], jnp.int32), keys[i], table, scratch)
     edge_counts.append(edges)  # stay async: no host sync in the loop
-  jax.block_until_ready(edge_counts[-1])
+    sigs.append(sig)
+  jax.block_until_ready((edge_counts[-1], sigs[-1]))
   dt = time.time() - t0
   total_edges = int(np.sum([int(e) for e in edge_counts]))
 

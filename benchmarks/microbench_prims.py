@@ -104,6 +104,43 @@ def main():
       timed(jax.jit(lambda k: jax.random.uniform(k, (15, F))),
             jax.random.key(1)))
 
+  # -- sort-engine internals at hop-2 widths ---------------------------
+  from glt_tpu.ops.scan import cumsum_i32
+  from glt_tpu.ops.unique import _fill_forward, sorted_hop_dedup
+  ind_m = (vals_m & 1)
+  rec('cumsum_i32_768k', timed(jax.jit(cumsum_i32), ind_m))
+  cm = 186_000 + M     # seen-set + slots, the real dedup sort width
+  hd = jnp.asarray((rng.random(cm) < 0.2))
+  pay1 = jnp.asarray(rng.integers(0, 1 << 20, cm).astype(np.int32))
+  pay2 = jnp.asarray(rng.integers(0, 1 << 20, cm).astype(np.int32))
+  rec('fill_forward_954k_2pay',
+      timed(jax.jit(lambda h, a, b: _fill_forward(h, a, b)), hd, pay1,
+            pay2))
+  u_ids = jnp.asarray(
+      rng.choice(N, 186_000, replace=False).astype(np.int32))
+  u_labs = jnp.arange(186_000, dtype=jnp.int32)
+  ok_m = jnp.asarray(rng.random(M) < 0.9)
+  rows_m = jnp.asarray(rng.integers(0, F, M).astype(np.int32))
+
+  @jax.jit
+  def dedup_full(uid, ula, ids, ok, rows):
+    d = sorted_hop_dedup(uid, ula, jnp.asarray(186_000, jnp.int32), ids,
+                         ok, rows)
+    return (d['labels3'], d['rows3'], d['new_head3'], d['u_ids2'],
+            d['count2'])
+
+  rec('sorted_hop_dedup_h2',
+      timed(dedup_full, u_ids, u_labs, idx_m, ok_m, rows_m))
+
+  # -- PRNG implementation A/B (threefry default vs rbg) ---------------
+  try:
+    rbg_key = jax.random.key(1, impl='rbg')
+    rec('uniform_15x153k_rbg',
+        timed(jax.jit(lambda k: jax.random.uniform(k, (15, F))),
+              rbg_key))
+  except Exception as e:
+    print(f'# rbg unavailable: {e}', file=sys.stderr)
+
   dev = jax.devices()[0]
   print(json.dumps({'metric': 'prim_ms', 'backend': dev.platform,
                     'shapes': {'N': N, 'E': E, 'M': M, 'F': F},

@@ -125,17 +125,49 @@ def main():
         assign_only,
         (jnp.asarray(nbrs), jnp.asarray(nmask), table, scratch),
         iters=args.iters, donate_state=True))
+
+    # the sort-merge inducer's equivalent stage at the same widths, with
+    # a realistic seen-set size (everything deduped before this hop)
+    from glt_tpu.ops.unique import sorted_hop_dedup
+    seen_c = sum(BATCH * int(np.prod(FANOUT[:i])) for i in range(h + 1))
+    u_ids = jnp.asarray(
+        rng.choice(NUM_NODES, seen_c, replace=False).astype(np.int32))
+    u_labs = jnp.arange(seen_c, dtype=jnp.int32)
+    rows_flat = jnp.asarray(
+        rng.integers(0, seen_c, width * k).astype(np.int32))
+
+    @jax.jit
+    def sorted_only(uid, ula, ids, ok, rows):
+      d = sorted_hop_dedup(uid, ula, jnp.asarray(seen_c, jnp.int32),
+                           ids, ok, rows)
+      return (d['labels3'], d['rows3'], d['new_head3'], d['u_ids2'],
+              d['count2'])
+
+    record(stages, f'sorted_h{h}', _time_fn(
+        sorted_only,
+        (u_ids, u_labs, jnp.asarray(nbrs), jnp.asarray(nmask),
+         rows_flat), iters=args.iters))
     width *= k
 
   # composed program (bench.py's work unit)
   one_hop = lambda ids, fanout, key, mask: sample_neighbors(
       indptr, indices, ids, fanout, key, seed_mask=mask)
 
+  def checksum(out):
+    # consume every output so no stage is dead code (see bench.py)
+    acc = jnp.zeros((), jnp.int32)
+    for k2 in ('node', 'row', 'col', 'batch', 'seed_labels'):
+      acc += out[k2].sum(dtype=jnp.int32)
+    acc += out['edge_mask'].sum(dtype=jnp.int32)
+    acc += out['node_count'].sum(dtype=jnp.int32)
+    return acc
+
   @functools.partial(jax.jit, donate_argnums=(2, 3))
   def composed(seeds, key, table, scratch):
     out, table, scratch = multihop_sample(
         one_hop, seeds, jnp.asarray(BATCH), FANOUT, key, table, scratch)
-    return out['num_sampled_edges'].sum(), table, scratch
+    return (out['num_sampled_edges'].sum() + checksum(out), table,
+            scratch)
 
   table, scratch = dense_make_tables(NUM_NODES)
   seeds = jnp.asarray(rng.integers(0, NUM_NODES, BATCH).astype(np.int32))
@@ -149,7 +181,8 @@ def main():
     outs, table, scratch = multihop_sample_many(
         one_hop, seeds2, jnp.full(scan, BATCH, jnp.int32), FANOUT, key,
         table, scratch)
-    return outs['num_sampled_edges'].sum(), table, scratch
+    return (outs['num_sampled_edges'].sum() + checksum(outs), table,
+            scratch)
 
   seeds2 = jnp.asarray(
       rng.integers(0, NUM_NODES, (scan, BATCH)).astype(np.int32))
@@ -170,13 +203,18 @@ def main():
     print(f'# trace written to {args.trace}')
 
   ms = {k: round(v * 1e3, 3) for k, v in stages.items()}
-  op_sum = sum(v for k, v in ms.items() if not k.startswith('composed'))
-  top3 = sorted((k for k in ms if not k.startswith('composed')),
-                key=lambda k: -ms[k])[:3]
+  # op_sum models the ACTIVE engine's composed program: both engines'
+  # dedup stages are timed above, but only one runs inside `composed`
+  from glt_tpu.ops.pipeline import dedup_engine
+  skip = 'sorted_' if dedup_engine() == 'table' else 'assign_'
+  in_sum = lambda k: not k.startswith('composed') and not k.startswith(skip)
+  op_sum = sum(v for k, v in ms.items() if in_sum(k))
+  top3 = sorted((k for k in ms if in_sum(k)), key=lambda k: -ms[k])[:3]
   dev = jax.devices()[0]
   out = {
       'metric': 'sampler_stage_ms',
       'stages': ms,
+      'engine': dedup_engine(),
       'op_sum_ms': round(op_sum, 3),
       'composed_over_opsum': round(ms['composed'] / max(op_sum, 1e-9), 2),
       'top3': top3,

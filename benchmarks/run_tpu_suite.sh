@@ -1,0 +1,37 @@
+#!/bin/bash
+# Full TPU perf suite — run whenever hardware is reachable. Each step
+# appends to benchmarks/tpu_runs/ so partial runs still leave evidence
+# (the axon tunnel can drop at any time).
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/tpu_runs
+mkdir -p "$OUT"
+
+run() {  # run NAME CMD... — capture json + log, keep going on failure
+  local name=$1; shift
+  echo "== $name: $*" >&2
+  "$@" > "$OUT/$name.json" 2> "$OUT/$name.log"
+  tail -c 200 "$OUT/$name.json" >&2; echo >&2
+}
+
+# 1. headline engine/scan/PRNG A/Bs (bench.py is supervised + retried)
+run bench_sort_scan4 python bench.py
+run bench_table_scan4 env GLT_DEDUP=table python bench.py
+run bench_sort_scan1 env GLT_BENCH_SCAN=1 python bench.py
+run bench_sort_scan8 env GLT_BENCH_SCAN=8 python bench.py
+run bench_sort_rbg env GLT_PRNG=rbg python bench.py
+
+# 2. primitive economics (incl. sort-engine internals + PRNG A/B)
+run microbench_prims_tpu python benchmarks/microbench_prims.py
+
+# 3. stage breakdown + profiler trace (top-op evidence)
+run profile_sampler_tpu python benchmarks/profile_sampler.py \
+    --trace /tmp/glt_trace
+
+# 4. feature gather: XLA vs Pallas row-DMA
+run bench_feature_xla python benchmarks/bench_feature.py
+run bench_feature_pallas env GLT_USE_PALLAS=1 \
+    python benchmarks/bench_feature.py
+
+# 5. epoch-time + accuracy protocol slice
+run bench_train_tpu python benchmarks/bench_train.py --max-steps 50

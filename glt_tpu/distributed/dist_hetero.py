@@ -21,7 +21,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.pipeline import multihop_sample_hetero
-from ..ops.unique import dense_make_tables
+from ..ops.pipeline import make_dedup_tables
 from ..typing import EdgeType, NodeType, reverse_edge_type
 from ..utils import as_numpy
 from ..utils.rng import RandomSeedManager
@@ -204,7 +204,7 @@ class DistHeteroNeighborSampler:
     shard = NamedSharding(self.mesh, P(self.axis))
     self.tables = {}
     for t, n in graph.node_counts.items():
-      table, scratch = dense_make_tables(n)
+      table, scratch = make_dedup_tables(n)
       self.tables[t] = (
           jax.device_put(jnp.broadcast_to(table, (n_dev,) + table.shape),
                          shard),

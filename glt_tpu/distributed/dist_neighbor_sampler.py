@@ -28,7 +28,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops.pipeline import edge_hop_offsets, multihop_sample
 from ..ops.sample import sample_neighbors
-from ..ops.unique import dense_make_tables
+from ..ops.pipeline import make_dedup_tables
 from ..parallel.collectives import all_to_all, bucket_by_owner, unbucket
 from ..utils import as_numpy
 from ..utils.rng import RandomSeedManager
@@ -132,7 +132,7 @@ class DistNeighborSampler:
     self._step = 0
     self._fn_cache = {}
     n_dev = self.mesh.shape[self.axis]
-    table, scratch = dense_make_tables(dist_graph.num_nodes)
+    table, scratch = make_dedup_tables(dist_graph.num_nodes)
     shard = NamedSharding(self.mesh, P(self.axis))
     self.tables = jax.device_put(
         jnp.broadcast_to(table, (n_dev,) + table.shape), shard)

@@ -21,7 +21,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..loader.transform import Batch
 from ..ops.pipeline import edge_hop_offsets, multihop_sample
-from ..ops.unique import dense_make_tables
+from ..ops.pipeline import make_dedup_tables
 from .dist_feature import DistFeature
 from .dist_graph import DistGraph
 from .dist_neighbor_sampler import make_dist_one_hop
@@ -58,7 +58,7 @@ class DistTrainStep:
     self.labels = jax.device_put(
         np.asarray(labels), NamedSharding(self.mesh, P()))
     n_dev = self.mesh.shape[self.axis]
-    table, scratch = dense_make_tables(dist_graph.num_nodes)
+    table, scratch = make_dedup_tables(dist_graph.num_nodes)
     shard = NamedSharding(self.mesh, P(self.axis))
     self.tables = jax.device_put(
         jnp.broadcast_to(table, (n_dev,) + table.shape), shard)

@@ -26,17 +26,31 @@ def dedup_engine() -> str:
   'table' (dense scatter tables, fast where random access is cheap —
   CPU) or 'sort' (sort-merge, fast where sorts are the vectorized
   primitive — TPU; see ops/unique.py). GLT_DEDUP=table|sort|auto
-  overrides; auto picks by backend. The hetero loop
-  (:func:`multihop_sample_hetero`) currently always uses the table
-  engine: its per-etype slicing assumes slot order, which the sorted
-  engine's permuted layout does not provide (port tracked in
-  benchmarks/PERF_PLAN.md)."""
+  overrides; auto picks by backend. Both the homo and hetero hop loops
+  honor the setting; the hetero sorted path restores slot order with
+  one extra per-type sort so per-etype slicing stays exact."""
   mode = os.environ.get('GLT_DEDUP', 'auto')
   if mode not in ('auto', 'sort', 'table'):
     raise ValueError(f'GLT_DEDUP={mode!r}: expected auto|sort|table')
   if mode == 'auto':
     return 'sort' if jax.default_backend() == 'tpu' else 'table'
   return mode
+
+
+def make_dedup_tables(num_nodes: int):
+  """Allocate inducer state for the active dedup engine: the dense
+  [N+1] tables for 'table', or 1-element placeholders for 'sort' —
+  whose seen-set lives in batch-sized arrays, so allocating real tables
+  would pin O(N) dead HBM per node type (~900 MB on papers100M). The
+  engine choice is read once here and again at trace time in
+  :func:`multihop_sample`; GLT_DEDUP must not change between allocating
+  a sampler's tables and tracing its step."""
+  from .unique import dense_make_tables
+  if dedup_engine() == 'sort':
+    # two distinct buffers: callers donate both, and donating one buffer
+    # twice is an XLA execute error
+    return jnp.zeros((1,), jnp.int32), jnp.zeros((1,), jnp.int32)
+  return dense_make_tables(num_nodes)
 
 
 def sample_budget(batch_size: int, fanouts: Sequence[int]) -> int:
@@ -147,8 +161,7 @@ def _multihop_sample_sorted(one_hop: OneHopFn,
   u_ids = jnp.zeros((0,), jnp.int32)
   u_labs = jnp.zeros((0,), jnp.int32)
   count = jnp.zeros((), jnp.int32)
-  d = sorted_hop_dedup(u_ids, u_labs, count, seeds, seed_mask,
-                       jnp.full((batch_size,), -1, jnp.int32))
+  d = sorted_hop_dedup(u_ids, u_labs, count, seeds, seed_mask)
   # contract: seed_labels in seed-slot order (tiny unsort over [batch])
   seed_labels = jax.lax.sort([d['pos3'], d['labels3']], num_keys=1)[1]
   seed_labels = jnp.where(seed_mask, seed_labels, -1)
@@ -168,7 +181,8 @@ def _multihop_sample_sorted(one_hop: OneHopFn,
     rows_flat = jnp.repeat(frontier_labels, width)
     eflat = out.eids.reshape(-1) if with_edge else None
     d = sorted_hop_dedup(u_ids, u_labs, count, out.nbrs.reshape(-1),
-                         out.mask.reshape(-1), rows_flat, eflat)
+                         out.mask.reshape(-1), rows_flat, eflat,
+                         with_mask=True)
     u_ids, u_labs, count = d['u_ids2'], d['u_labs2'], d['count2']
     rows_parent.append(d['rows3'])
     cols_child.append(d['labels3'])
@@ -243,6 +257,11 @@ def multihop_sample_hetero(one_hops, trav, num_neighbors, num_hops,
   and seed_labels dicts, per-hop counts. Tables come back reset.
   """
   from .unique import dense_assign, dense_init, dense_reset
+  if dedup_engine() == 'sort':
+    result = _multihop_sample_hetero_sorted(
+        one_hops, trav, num_neighbors, num_hops, caps, budgets, seeds,
+        n_valid, key, with_edge=with_edge)
+    return result, tables
   types = list(budgets)
   states = {t: dense_init(tables[t][0], tables[t][1], budgets[t])
             for t in types}
@@ -325,6 +344,106 @@ def multihop_sample_hetero(one_hops, trav, num_neighbors, num_hops,
   if with_edge:
     result['edge'] = {e: jnp.concatenate(v) for e, v in eid_d.items()}
   return result, out_tables
+
+
+def _multihop_sample_hetero_sorted(one_hops, trav, num_neighbors,
+                                   num_hops, caps, budgets, seeds,
+                                   n_valid, key, with_edge: bool = False):
+  """The hetero hop loop on the sort-merge inducer: per node type an
+  append-form seen-set threaded through :func:`sorted_hop_dedup`, with
+  one extra sort per (type, hop) un-permuting labels back to slot order
+  so the per-etype cursor slicing below is identical to the table path.
+  Label/node/batch/count semantics match the table engine exactly (same
+  first-occurrence order over valid slots); per-etype edge tuples are
+  the same sets in the same slot order."""
+  types = list(budgets)
+  seen = {t: (jnp.zeros((0,), jnp.int32), jnp.zeros((0,), jnp.int32),
+              jnp.zeros((), jnp.int32)) for t in types}
+  seed_labels = {}
+  frontier = {}
+  for t in types:
+    c0 = max(1, caps[0][t])
+    if t in seeds:
+      s = seeds[t]
+      mask = jnp.arange(s.shape[0]) < n_valid[t]
+      d = sorted_hop_dedup(*seen[t], s, mask)
+      sl = jax.lax.sort([d['pos3'], d['labels3']], num_keys=1)[1]
+      seed_labels[t] = jnp.where(mask, sl, -1)
+      seen[t] = (d['u_ids2'], d['u_labs2'], d['count2'])
+      frontier[t] = (d['ids3'], d['labels3'], d['new_head3'])
+    else:
+      frontier[t] = (jnp.zeros((c0,), jnp.int32),
+                     jnp.full((c0,), -1, jnp.int32),
+                     jnp.zeros((c0,), bool))
+
+  rows_d, cols_d, mask_d, eid_d = {}, {}, {}, {}
+  hop_nodes = {t: [seen[t][2]] for t in types}
+  hop_edges = {}
+  for h in range(num_hops):
+    per_type = {t: [] for t in types}
+    per_meta = []
+    for e, (row_t, col_t) in trav.items():
+      k = num_neighbors[e][h]
+      if caps[h][row_t] == 0 or k == 0:
+        continue
+      width = abs(k)
+      f_ids, f_labels, f_mask = frontier[row_t]
+      key, sub = jax.random.split(key)
+      out = one_hops[e](f_ids, k, sub, f_mask)
+      mflat = out.mask.reshape(-1)
+      per_type[col_t].append((out.nbrs.reshape(-1), mflat))
+      per_meta.append((e, col_t, jnp.repeat(f_labels, width), mflat,
+                       out.eids.reshape(-1) if with_edge else None,
+                       caps[h][row_t] * width))
+    labels_by_type = {}
+    for t, chunks in per_type.items():
+      if not chunks:
+        cap_next = max(1, caps[h + 1][t])
+        frontier[t] = (jnp.zeros((cap_next,), jnp.int32),
+                       jnp.full((cap_next,), -1, jnp.int32),
+                       jnp.zeros((cap_next,), bool))
+        hop_nodes[t].append(jnp.zeros((), jnp.int32))
+        continue
+      ids = jnp.concatenate([c[0] for c in chunks])
+      ok = jnp.concatenate([c[1] for c in chunks])
+      # rows/mask/eids are NOT threaded through the sorts here: the hop's
+      # edge buffers are rebuilt in slot order below (per_meta), so the
+      # dedup sorts stay as narrow as possible
+      d = sorted_hop_dedup(*seen[t], ids, ok)
+      seen[t] = (d['u_ids2'], d['u_labs2'], d['count2'])
+      # slot-order labels: cols for this hop's edge buffers
+      labels_by_type[t] = jax.lax.sort([d['pos3'], d['labels3']],
+                                       num_keys=1)[1]
+      frontier[t] = (d['ids3'], d['labels3'], d['new_head3'])
+      hop_nodes[t].append(d['new_count'])
+    cursor = {t: 0 for t in types}
+    for e, col_t, rows_parent, mask, eids, width in per_meta:
+      s = cursor[col_t]
+      cursor[col_t] += width
+      lab = jax.lax.slice(labels_by_type[col_t], (s,), (s + width,))
+      rows_d.setdefault(e, []).append(rows_parent)
+      cols_d.setdefault(e, []).append(jnp.where(mask, lab, -1))
+      mask_d.setdefault(e, []).append(mask)
+      if with_edge:
+        eid_d.setdefault(e, []).append(eids)
+      hop_edges.setdefault(e, []).append(mask.sum().astype(jnp.int32))
+
+  nodes = {t: sorted_nodes_by_label(*seen[t], budgets[t]) for t in types}
+  result = dict(
+      node=nodes,
+      node_count={t: seen[t][2] for t in types},
+      row={e: jnp.concatenate(v) for e, v in rows_d.items()},
+      col={e: jnp.concatenate(v) for e, v in cols_d.items()},
+      edge_mask={e: jnp.concatenate(v) for e, v in mask_d.items()},
+      batch={t: jax.lax.slice(nodes[t], (0,), (seeds[t].shape[0],))
+             for t in seeds},
+      seed_labels=seed_labels,
+      num_sampled_nodes={t: jnp.stack(v) for t, v in hop_nodes.items()},
+      num_sampled_edges={e: jnp.stack(v) for e, v in hop_edges.items()},
+  )
+  if with_edge:
+    result['edge'] = {e: jnp.concatenate(v) for e, v in eid_d.items()}
+  return result
 
 
 def multihop_sample_many(one_hop: OneHopFn,

@@ -216,8 +216,9 @@ def sorted_hop_dedup(
     count: jax.Array,    # scalar int32: labels assigned so far
     ids: jax.Array,      # [M] sampled ids for this hop (dups allowed)
     valid: jax.Array,    # [M]
-    rows: jax.Array,     # [M] parent labels, carried through the sorts
+    rows: Optional[jax.Array] = None,  # [M] parent labels, carried along
     eids: Optional[jax.Array] = None,  # [M] edge ids, carried if given
+    with_mask: bool = False,           # carry the validity per element
 ):
   """One hop of dedup/relabel with ZERO random-memory ops — two
   multi-operand sorts plus prefix scans.
@@ -229,8 +230,13 @@ def sorted_hop_dedup(
   the same permutation, so edge tuples stay consistent; within-hop edge
   order is unspecified (hop blocks themselves stay separate).
 
+  ``rows``/``eids``/``with_mask`` add payload operands to both sorts —
+  callers that rebuild edge buffers in slot order (the hetero loop)
+  omit them to keep the sorts narrow.
+
   Returns a dict with:
-    ids3 / labels3 / rows3 / mask3 / eids3 : [M] aligned per-element
+    ids3 / labels3 : [M] aligned per-element
+    rows3 / mask3 / eids3 : [M] iff the matching payload was requested
     new_head3 : [M] True at the first occurrence of each new id
     pos3      : [M] original slot index of each element
     u_ids2 / u_labs2 : [C+M] updated seen-set (append-form, not sorted)
@@ -244,17 +250,21 @@ def sorted_hop_dedup(
   cat_pos = jnp.concatenate([jnp.full((c,), -1, jnp.int32),
                              jnp.arange(m, dtype=jnp.int32)])
   cat_lab = jnp.concatenate([u_labs, jnp.full((m,), -1, jnp.int32)])
-  cat_row = jnp.concatenate([jnp.full((c,), -1, jnp.int32),
-                             rows.astype(jnp.int32)])
-  cat_msk = jnp.concatenate([jnp.zeros((c,), jnp.int32),
-                             valid.astype(jnp.int32)])
-  ops = [cat_id, cat_pos, cat_lab, cat_row, cat_msk]
+  ops = [cat_id, cat_pos, cat_lab]
+  pay = []  # (name, array) payloads threaded through both sorts
+  if rows is not None:
+    pay.append(('rows3', jnp.concatenate(
+        [jnp.full((c,), -1, jnp.int32), rows.astype(jnp.int32)])))
+  if with_mask:
+    pay.append(('mask3', jnp.concatenate(
+        [jnp.zeros((c,), jnp.int32), valid.astype(jnp.int32)])))
   if eids is not None:
-    ops.append(jnp.concatenate([jnp.full((c,), -1, eids.dtype), eids]))
+    pay.append(('eids3', jnp.concatenate(
+        [jnp.full((c,), -1, eids.dtype), eids])))
   # sort 1: (id, pos) — a seen-set entry (pos -1) heads its id-run
-  s = jax.lax.sort(ops, num_keys=2)
-  sid, spos, slab, srow, smsk = s[:5]
-  seid = s[5] if eids is not None else None
+  s = jax.lax.sort(ops + [p for _, p in pay], num_keys=2)
+  sid, spos, slab = s[:3]
+  spay = s[3:]
 
   hd = jnp.concatenate([jnp.ones((1,), bool), sid[1:] != sid[:-1]])
   hd = hd & (sid != big)
@@ -268,13 +278,12 @@ def sorted_hop_dedup(
   # slot elements therefore land in [:M].
   is_slot = spos >= 0
   gkey = jnp.where(is_slot, jnp.where(is_new_run, head_spos, spos), big)
-  ops2 = [gkey, spos, sid, u_lab, is_new_run.astype(jnp.int32), srow,
-          smsk]
-  if eids is not None:
-    ops2.append(seid)
-  s2 = jax.lax.sort(ops2, num_keys=2)
-  gkey2, pos3, ids3, ulab3, new3, rows3, msk3 = (a[:m] for a in s2[:7])
-  eids3 = s2[7][:m] if eids is not None else None
+  ops2 = [gkey, spos, sid, u_lab, is_new_run.astype(jnp.int32)]
+  s2 = jax.lax.sort(ops2 + list(spay), num_keys=2)
+  gkey2, pos3, ids3, ulab3, new3 = (a[:m] for a in s2[:5])
+  out_pay = {name: s2[5 + i][:m] for i, (name, _) in enumerate(pay)}
+  if 'mask3' in out_pay:
+    out_pay['mask3'] = out_pay['mask3'].astype(bool)
   new3 = new3.astype(bool)
 
   # the first element of each new group is its head (pos == group key);
@@ -289,10 +298,9 @@ def sorted_hop_dedup(
   u_ids2 = jnp.concatenate([u_ids, jnp.where(new_head3, ids3, big)])
   u_labs2 = jnp.concatenate([u_labs, jnp.where(new_head3, labels3,
                                                big)])
-  return dict(ids3=ids3, labels3=labels3, rows3=rows3,
-              mask3=msk3.astype(bool), eids3=eids3, new_head3=new_head3,
+  return dict(ids3=ids3, labels3=labels3, new_head3=new_head3,
               pos3=pos3, u_ids2=u_ids2, u_labs2=u_labs2,
-              count2=count + new_count, new_count=new_count)
+              count2=count + new_count, new_count=new_count, **out_pay)
 
 
 def sorted_nodes_by_label(u_ids: jax.Array, u_labs: jax.Array,

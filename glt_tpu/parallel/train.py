@@ -22,7 +22,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..data import Graph
 from ..ops.pipeline import edge_hop_offsets, multihop_sample, sample_budget
 from ..ops.sample import sample_neighbors
-from ..ops.unique import dense_make_tables
+from ..ops.pipeline import make_dedup_tables
 from ..loader.transform import Batch
 
 
@@ -56,7 +56,7 @@ class SPMDSageTrainStep:
     self.labels = jax.device_put(labels, NamedSharding(mesh, P()))
     n_dev = mesh.shape[axis]
     # per-device inducer tables, stacked on the mesh axis
-    table, scratch = dense_make_tables(graph.num_nodes)
+    table, scratch = make_dedup_tables(graph.num_nodes)
     self.tables = jax.device_put(
         jnp.broadcast_to(table, (n_dev,) + table.shape),
         NamedSharding(mesh, P(axis)))

@@ -26,15 +26,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..data import Graph
-from ..ops.pipeline import edge_hop_offsets, hetero_edge_hop_offsets, \
-    multihop_sample, multihop_sample_hetero
+from ..ops.pipeline import dedup_engine, edge_hop_offsets, \
+    hetero_edge_hop_offsets, make_dedup_tables, multihop_sample, \
+    multihop_sample_hetero
 from ..ops.sample import (
     neighbor_probs, sample_full_neighbors, sample_neighbors,
     sample_neighbors_weighted,
 )
 from ..ops.subgraph import induced_subgraph
-from ..ops.unique import (
-    dense_make_tables, )
 from ..typing import EdgeType, NodeType, reverse_edge_type
 from ..utils import as_numpy
 from ..utils.rng import RandomSeedManager
@@ -162,10 +161,12 @@ class NeighborSampler(BaseSampler):
 
   def _get_tables(self, ntype: str, num_nodes: int):
     if ntype not in self._tables:
-      assert num_nodes <= DENSE_TABLE_NODE_LIMIT, (
+      assert (dedup_engine() == 'sort'
+              or num_nodes <= DENSE_TABLE_NODE_LIMIT), (
           f'node space {num_nodes} exceeds dense-table limit; '
-          'shard the graph (distributed sampler) instead')
-      self._tables[ntype] = dense_make_tables(num_nodes)
+          'shard the graph (distributed sampler) or use the sort-merge '
+          'inducer (GLT_DEDUP=sort) instead')
+      self._tables[ntype] = make_dedup_tables(num_nodes)
     return self._tables[ntype]
 
   def _one_hop(self, g: Graph, frontier, fanout, key, mask):

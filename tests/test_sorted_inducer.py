@@ -116,6 +116,55 @@ def test_sorted_engine_random_graph_invariants(monkeypatch):
   np.testing.assert_array_equal(nodes[sl], np.asarray(seeds))
 
 
+@pytest.mark.parametrize('fanouts', [[2], [2, 2]])
+def test_sorted_engine_matches_table_hetero(monkeypatch, fanouts):
+  # exhaustive fanouts (deg 2 everywhere) make both engines see the same
+  # neighbor sets; labels/nodes/counts must then match exactly, edge
+  # tuples as per-hop multisets
+  from fixtures import hetero_ring_dataset
+  from glt_tpu.sampler import NeighborSampler, NodeSamplerInput
+  ds = hetero_ring_dataset(num_users=10, num_items=20)
+  u2i = ('user', 'u2i', 'item')
+  i2i = ('item', 'i2i', 'item')
+  seeds = NodeSamplerInput(np.array([3, 7, 3, 9]), 'user')
+  key = jax.random.key(5)
+
+  outs = {}
+  for engine in ('table', 'sort'):
+    monkeypatch.setenv('GLT_DEDUP', engine)
+    s = NeighborSampler(ds.graph, {u2i: fanouts, i2i: fanouts},
+                        with_edge=True, seed=4)
+    outs[engine] = s.sample_from_nodes(seeds, key=key)
+  a, b = outs['table'], outs['sort']
+
+  for t in ('user', 'item'):
+    assert int(a.node_count[t]) == int(b.node_count[t])
+    np.testing.assert_array_equal(np.asarray(a.node[t]),
+                                  np.asarray(b.node[t]))
+    np.testing.assert_array_equal(np.asarray(a.batch.get(t, [])),
+                                  np.asarray(b.batch.get(t, [])))
+    np.testing.assert_array_equal(np.asarray(a.num_sampled_nodes[t]),
+                                  np.asarray(b.num_sampled_nodes[t]))
+  for t in a.metadata['seed_labels']:
+    np.testing.assert_array_equal(
+        np.asarray(a.metadata['seed_labels'][t]),
+        np.asarray(b.metadata['seed_labels'][t]))
+  assert set(a.row) == set(b.row)
+  for e in a.row:
+    np.testing.assert_array_equal(np.asarray(a.num_sampled_edges[e]),
+                                  np.asarray(b.num_sampled_edges[e]))
+    offs = a.metadata['edge_hop_offsets'][e]
+    assert offs == b.metadata['edge_hop_offsets'][e]
+    for h in range(len(offs) - 1):
+      lo, hi = offs[h], offs[h + 1]
+      def hop_tuples(o):
+        m = np.asarray(o.edge_mask[e])[lo:hi].astype(bool)
+        return sorted(zip(np.asarray(o.row[e])[lo:hi][m].tolist(),
+                          np.asarray(o.col[e])[lo:hi][m].tolist(),
+                          np.asarray(o.edge[e])[lo:hi][m].tolist()))
+      assert hop_tuples(a) == hop_tuples(b)
+
+
 def test_sorted_hop_dedup_unit():
   # tiny hand-checked case incl. seen-set reuse and duplicates
   u_ids = jnp.array([40, 7], jnp.int32)       # labels 0, 1 already taken
