@@ -95,19 +95,7 @@ def run_worker():
   import functools
   scan = max(int(os.environ.get('GLT_BENCH_SCAN', '4')), 1)
 
-  def checksum(out):
-    # Fold EVERY output into the returned scalars so no stage of the
-    # pipeline is dead code: without this, XLA correctly deletes the
-    # last hop's neighbor gather + dedup (their values feed nothing) and
-    # the bench measures a program no real consumer runs. The reference
-    # bench materializes full sample results (bench_sampler.py); cheap
-    # vectorized reductions are the static-shape equivalent.
-    acc = jnp.zeros((), jnp.int32)
-    for k in ('node', 'row', 'col', 'batch', 'seed_labels'):
-      acc += out[k].sum(dtype=jnp.int32)
-    acc += out['edge_mask'].sum(dtype=jnp.int32)
-    acc += out['node_count'].sum(dtype=jnp.int32)
-    return acc
+  from glt_tpu.ops.pipeline import checksum_outputs as checksum
 
   @functools.partial(jax.jit, donate_argnums=(2, 3))
   def sample_batch(seeds, key, table, scratch):
@@ -126,11 +114,9 @@ def run_worker():
   table, scratch = make_dedup_tables(NUM_NODES)
   seed_pool = rng.integers(0, NUM_NODES, (ITERS + WARMUP, scan, BATCH))
   # GLT_PRNG=rbg swaps threefry for the XLA RngBitGenerator-backed
-  # implementation (typed keys propagate the impl through every split
-  # inside the pipeline); counter-based threefry stays the default for
-  # reproducibility across backends
-  impl = os.environ.get('GLT_PRNG') or None
-  keys = jax.random.split(jax.random.key(0, impl=impl), ITERS + WARMUP)
+  # implementation (same knob the library samplers honor, utils/rng.py)
+  from glt_tpu.utils.rng import make_key
+  keys = jax.random.split(make_key(0), ITERS + WARMUP)
 
   edges = None
   for i in range(WARMUP):

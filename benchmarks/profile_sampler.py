@@ -153,14 +153,8 @@ def main():
   one_hop = lambda ids, fanout, key, mask: sample_neighbors(
       indptr, indices, ids, fanout, key, seed_mask=mask)
 
-  def checksum(out):
-    # consume every output so no stage is dead code (see bench.py)
-    acc = jnp.zeros((), jnp.int32)
-    for k2 in ('node', 'row', 'col', 'batch', 'seed_labels'):
-      acc += out[k2].sum(dtype=jnp.int32)
-    acc += out['edge_mask'].sum(dtype=jnp.int32)
-    acc += out['node_count'].sum(dtype=jnp.int32)
-    return acc
+  from glt_tpu.ops.pipeline import checksum_outputs as checksum
+  from glt_tpu.ops.pipeline import make_dedup_tables
 
   @functools.partial(jax.jit, donate_argnums=(2, 3))
   def composed(seeds, key, table, scratch):
@@ -169,7 +163,7 @@ def main():
     return (out['num_sampled_edges'].sum() + checksum(out), table,
             scratch)
 
-  table, scratch = dense_make_tables(NUM_NODES)
+  table, scratch = make_dedup_tables(NUM_NODES)
   seeds = jnp.asarray(rng.integers(0, NUM_NODES, BATCH).astype(np.int32))
   record(stages, 'composed', _time_fn(composed, (seeds, key, table, scratch),
                                       iters=args.iters, donate_state=True))
@@ -186,13 +180,13 @@ def main():
 
   seeds2 = jnp.asarray(
       rng.integers(0, NUM_NODES, (scan, BATCH)).astype(np.int32))
-  table, scratch = dense_make_tables(NUM_NODES)
+  table, scratch = make_dedup_tables(NUM_NODES)
   record(stages, 'composed_scan_per_batch', _time_fn(
       composed_scan, (seeds2, key, table, scratch),
       iters=args.iters, donate_state=True) / scan)
 
   if args.trace:
-    table, scratch = dense_make_tables(NUM_NODES)
+    table, scratch = make_dedup_tables(NUM_NODES)
     state = (seeds, key, table, scratch)
     out = composed(*state)  # ensure compiled before tracing
     jax.block_until_ready(out)
@@ -225,7 +219,7 @@ def main():
     # vs flops shows how bandwidth-bound the sampler is. lower() only
     # needs avals, so pass shape specs instead of fresh device buffers.
     spec = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
-    t_spec = jax.ShapeDtypeStruct((NUM_NODES + 1,), jnp.int32)
+    t_spec = jax.ShapeDtypeStruct(table.shape, jnp.int32)
     ca = composed.lower(spec(seeds), spec(key), t_spec, t_spec) \
         .compile().cost_analysis()
     if isinstance(ca, (list, tuple)):

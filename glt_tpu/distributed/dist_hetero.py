@@ -195,7 +195,8 @@ class DistHeteroNeighborSampler:
     hops = {len(v) for v in self.num_neighbors.values()}
     assert len(hops) == 1
     self.num_hops = hops.pop()
-    self._base_key = jax.random.key(
+    from ..utils.rng import make_key
+    self._base_key = make_key(
         seed if seed is not None
         else RandomSeedManager.getInstance().getSeed())
     self._step = 0

@@ -78,7 +78,8 @@ class DistLinkNeighborLoader:
       self._strict_neg = DistRandomNegativeSampler(
           dist_graph, trials_num=5, padding=True)
     # reproducible negative stream derived from the loader's seed
-    self._neg_key = jax.random.key(seed if seed is not None else 0)
+    from ..utils.rng import make_key
+    self._neg_key = make_key(seed if seed is not None else 0)
     self.feature = dist_feature
 
   def __len__(self):

@@ -126,7 +126,8 @@ class DistNeighborSampler:
                                 or getattr(dist_graph, 'max_degree', 1))
     self.mesh = dist_graph.mesh
     self.axis = dist_graph.axis
-    self._base_key = jax.random.key(
+    from ..utils.rng import make_key
+    self._base_key = make_key(
         seed if seed is not None
         else RandomSeedManager.getInstance().getSeed())
     self._step = 0

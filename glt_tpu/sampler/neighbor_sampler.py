@@ -93,11 +93,10 @@ class NeighborSampler(BaseSampler):
     self.device = device
     self.max_weighted_degree = max_weighted_degree
     self.full_neighbor_cap = full_neighbor_cap
-    if seed is not None:
-      self._base_key = jax.random.key(seed)
-    else:
-      self._base_key = jax.random.key(
-          RandomSeedManager.getInstance().getSeed())
+    from ..utils.rng import make_key
+    self._base_key = make_key(
+        seed if seed is not None
+        else RandomSeedManager.getInstance().getSeed())
     self._step = 0
 
     # device placement must happen eagerly — inside a jit trace the

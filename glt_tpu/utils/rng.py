@@ -8,9 +8,25 @@ a key stream, while the whole run stays reproducible from one seed.
 """
 from __future__ import annotations
 
+import os
 import threading
 
 import jax
+
+
+def make_key(seed: int) -> jax.Array:
+  """Typed PRNG key honoring ``GLT_PRNG`` (e.g. ``rbg``).
+
+  threefry (jax default) is counter-based and bit-reproducible across
+  backends — the right default for tests and parity. ``GLT_PRNG=rbg``
+  selects the XLA RngBitGenerator implementation, which generates bits
+  several times faster on TPU (benchmarks/microbench_prims.py
+  uniform_15x153k A/B) at the cost of cross-backend reproducibility.
+  The impl travels inside the typed key, so every ``jax.random.split``
+  / ``fold_in`` downstream inherits it.
+  """
+  impl = os.environ.get('GLT_PRNG') or None
+  return jax.random.key(int(seed), impl=impl)
 
 
 class RandomSeedManager:
@@ -41,7 +57,7 @@ class RandomSeedManager:
     with self._local:
       c = self._counter
       self._counter += 1
-    return jax.random.fold_in(jax.random.key(self._seed), c)
+    return jax.random.fold_in(make_key(self._seed), c)
 
 
 def new_key() -> jax.Array:

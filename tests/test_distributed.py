@@ -774,3 +774,67 @@ def test_dist_subgraph_loader_edge_features(mesh, part_dir_ef,
       np.testing.assert_allclose(item['edge_attr'][:, 0], item['eids'])
       saw += item['eids'].shape[0]
   assert saw > 0
+
+
+# -- sort-merge inducer inside the SPMD program --------------------------
+# On real TPU hardware GLT_DEDUP=auto resolves to 'sort', so the
+# collective one-hop is fed the sorted engine's permuted, _BIG-padded
+# frontier. These force that engine on the CPU mesh and re-assert the
+# exactness the table-engine tests above establish.
+
+def test_dist_sampler_sort_engine_exact(mesh, part_dir, monkeypatch):
+  monkeypatch.setenv('GLT_DEDUP', 'sort')
+  dg = DistGraph.from_dataset_partitions(mesh, part_dir)
+  s = DistNeighborSampler(dg, [2, 2], with_edge=True, seed=1)
+  seeds = np.arange(N_PARTS)[:, None]
+  out = s.sample_from_nodes(seeds)
+  nodes = np.asarray(out['node'])
+  counts = np.asarray(out['node_count'])
+  for p in range(N_PARTS):
+    got = set(nodes[p][:counts[p]].tolist())
+    expect = {p, (p + 1) % N_NODES, (p + 2) % N_NODES,
+              (p + 3) % N_NODES, (p + 4) % N_NODES}
+    assert got == expect
+    em = np.asarray(out['edge_mask'])[p]
+    child = nodes[p][np.asarray(out['row'])[p][em]]
+    parent = nodes[p][np.asarray(out['col'])[p][em]]
+    for pp, cc in zip(parent, child):
+      assert cc in ((pp + 1) % N_NODES, (pp + 2) % N_NODES)
+    # hop-0 edge ids are the seed's out-edges {2p, 2p+1}
+    offs = out['edge_hop_offsets']
+    em0 = em[offs[0]:offs[1]]
+    eids0 = np.asarray(out['edge'])[p][offs[0]:offs[1]][em0]
+    assert set(eids0.tolist()) == {2 * p, 2 * p + 1}
+
+
+def test_dist_hetero_sampler_sort_engine(tmp_path_factory, mesh,
+                                         monkeypatch):
+  monkeypatch.setenv('GLT_DEDUP', 'sort')
+  from glt_tpu.distributed import DistHeteroGraph, DistHeteroNeighborSampler
+  root = str(tmp_path_factory.mktemp('hetero_parts_sort'))
+  u2i = ('user', 'u2i', 'item')
+  i2i = ('item', 'i2i', 'item')
+  nu, ni = 16, 32
+  u = np.arange(nu)
+  u2i_ei = np.stack([np.repeat(u, 2),
+                     np.stack([2*u, 2*u+1], 1).reshape(-1) % ni])
+  i = np.arange(ni)
+  i2i_ei = np.stack([np.repeat(i, 2),
+                     np.stack([(i+1) % ni, (i+2) % ni], 1).reshape(-1)])
+  RandomPartitioner(root, num_parts=N_PARTS,
+                    num_nodes={'user': nu, 'item': ni},
+                    edge_index={u2i: u2i_ei, i2i: i2i_ei}).partition()
+  dg = DistHeteroGraph.from_dataset_partitions(mesh, root)
+  s = DistHeteroNeighborSampler(dg, {u2i: [2, 2], i2i: [2, 2]}, seed=0)
+  seeds = (np.arange(N_PARTS) % nu)[:, None]
+  out = s.sample_from_nodes('user', seeds)
+  items = np.asarray(out['node']['item'])
+  icount = np.asarray(out['node_count']['item'])
+  for p in range(N_PARTS):
+    uu = p % nu
+    expect = {2*uu % ni, (2*uu+1) % ni}
+    for v in list(expect):
+      expect |= {(v+1) % ni, (v+2) % ni}
+    got = set(items[p][:icount[p]].tolist())
+    assert got == expect, f'dev {p}: {got} != {expect}'
+  assert ('item', 'rev_u2i', 'user') in out['row']
