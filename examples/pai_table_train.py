@@ -1,0 +1,99 @@
+"""Table-sourced training — the reference's examples/pai/ogbn_products
+workload (TableDataset fed by ODPS table readers, then standard
+supervised SAGE). The ODPS service is unreachable outside Alibaba
+cloud; the reader protocol is the capability, so this script feeds the
+same TableDataset.load path from CSV readers written to a temp dir —
+swap `csv_*_reader` for `odps_table_reader('odps://...')` on PAI.
+"""
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import common  # noqa: F401  (GLT_PLATFORM handling)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from glt_tpu.data.table_dataset import (
+    TableDataset, csv_edge_reader, csv_node_reader,
+)
+from glt_tpu.loader import NeighborLoader
+from glt_tpu.models import GraphSAGE
+
+
+def write_tables(root, num_nodes=2_000, avg_deg=8, feat_dim=32,
+                 num_classes=8, seed=0):
+  """Emit edge/node tables in the (src,dst[,weight]) / (id,feat...,label)
+  record layout the readers stream."""
+  rng = np.random.default_rng(seed)
+  e = num_nodes * avg_deg
+  src = rng.integers(0, num_nodes, e)
+  dst = rng.integers(0, num_nodes, e)
+  edge_csv = os.path.join(root, 'edges.csv')
+  with open(edge_csv, 'w') as f:
+    for s, d in zip(src, dst):
+      f.write(f'{s},{d}\n')
+  feats = rng.normal(size=(num_nodes, feat_dim)).astype(np.float32)
+  w = rng.normal(size=(feat_dim, num_classes)).astype(np.float32)
+  labels = np.argmax(feats @ w, 1)
+  node_csv = os.path.join(root, 'nodes.csv')
+  # reader record layout: id,<f0:f1:...>,label (csv_node_reader)
+  with open(node_csv, 'w') as f:
+    for i in range(num_nodes):
+      row = ':'.join(f'{v:.6f}' for v in feats[i])
+      f.write(f'{i},{row},{labels[i]}\n')
+  return edge_csv, node_csv, num_nodes, num_classes
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--epochs', type=int, default=2)
+  ap.add_argument('--batch-size', type=int, default=256)
+  args = ap.parse_args()
+
+  with tempfile.TemporaryDirectory() as root:
+    edge_csv, node_csv, n, num_classes = write_tables(root)
+    ds = TableDataset(edge_dir='out').load(
+        edge_reader=csv_edge_reader(edge_csv),
+        node_reader=csv_node_reader(node_csv, label_col=2),
+        num_nodes=n)
+
+    loader = NeighborLoader(ds, [10, 5], input_nodes=np.arange(n),
+                            batch_size=args.batch_size, shuffle=True,
+                            seed=0)
+    model = GraphSAGE(hidden_features=128, out_features=num_classes,
+                      num_layers=2)
+    b0 = next(iter(loader))
+    params = model.init(jax.random.key(0), b0)
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt, batch):
+      def loss_fn(p):
+        logits = model.apply(p, batch)
+        mask = jnp.arange(logits.shape[0]) < batch.metadata['n_valid']
+        l = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch.y)
+        return jnp.where(mask, l, 0).sum() / jnp.maximum(mask.sum(), 1)
+      loss, g = jax.value_and_grad(loss_fn)(params)
+      up, opt = tx.update(g, opt)
+      return optax.apply_updates(params, up), opt, loss
+
+    for epoch in range(args.epochs):
+      for batch in loader:
+        meta = dict(batch.metadata)
+        meta['n_valid'] = jnp.asarray(meta['n_valid'])
+        params, opt, loss = step(params, opt,
+                                 batch.replace(metadata=meta))
+      print(f'epoch {epoch}: loss={float(loss):.4f}')
+
+
+if __name__ == '__main__':
+  main()

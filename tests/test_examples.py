@@ -92,3 +92,14 @@ def test_bipartite_sage_unsup_example():
   out = _run(os.path.join('hetero', 'bipartite_sage_unsup.py'),
              '--epochs', '2', '--users', '300', timeout=400)
   assert 'test_auc=' in out
+
+
+def test_hgt_mag_example():
+  out = _run(os.path.join('hetero', 'train_hgt_mag.py'), '--epochs', '1',
+             timeout=300)
+  assert 'loss=' in out
+
+
+def test_pai_table_train_example():
+  out = _run('pai_table_train.py', '--epochs', '1', timeout=300)
+  assert 'loss=' in out
