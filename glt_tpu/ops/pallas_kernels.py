@@ -37,6 +37,77 @@ def use_pallas_default() -> bool:
           and jax.default_backend() == 'tpu')
 
 
+@functools.partial(jax.jit, static_argnames=('width', 'block',
+                                             'interpret'))
+def gather_windows(arr: jax.Array, starts: jax.Array, width: int,
+                   block: int = 8, interpret: bool = False) -> jax.Array:
+  """Contiguous-window gather: out[i] = arr[starts[i] : starts[i]+width].
+
+  The windowed gathers of the sampling pipeline (weighted sampling and
+  full-neighborhood expansion read a [S, max_degree] neighbor window per
+  seed; the feature store reads [S, D] rows) lower on XLA:TPU to a
+  serialized per-OUTPUT-element loop (~8-16 ns/element,
+  benchmarks/microbench_prims.py) — ~0.8 us/row at width 96. Here each
+  row is ONE async HBM->VMEM DMA descriptor instead; ``block`` rows'
+  descriptors are in flight at once, so per-row cost is DMA-issue
+  overhead + bytes/bandwidth, independent of width.
+
+  CONTRACT (stricter than the XLA slice-gather): a window must lie
+  fully inside the array — ``starts`` are clamped to
+  [0, len(arr) - width], so a tail window with ``start > len - width``
+  is SHIFTED left and returns wrong values in otherwise-valid lanes
+  (XLA's per-element mode='clip' only corrupts lanes past the row's
+  degree, which callers mask). Wire this into samplers only over a
+  source array padded by ``width`` trailing elements; the microbench
+  satisfies the precondition by drawing starts from [0, E - W].
+  Callers mask invalid lanes themselves.
+  """
+  from jax.experimental import pallas as pl
+  from jax.experimental.pallas import tpu as pltpu
+
+  e = arr.shape[0]
+  s = starts.shape[0]
+  assert e >= width, f'array ({e}) shorter than the window ({width})'
+  starts = jnp.clip(starts.astype(jnp.int32), 0, e - width)
+  pad = (-s) % block
+  if pad:
+    starts = jnp.pad(starts, (0, pad))
+  n_blocks = (s + pad) // block
+
+  def kernel(starts_ref, arr_ref, out_ref, sems):
+    i = pl.program_id(0)
+
+    def start_dma(j, _):
+      st = starts_ref[i * block + j]
+      pltpu.make_async_copy(arr_ref.at[pl.ds(st, width)],
+                            out_ref.at[j], sems.at[j]).start()
+      return 0
+
+    def wait_dma(j, _):
+      st = starts_ref[i * block + j]
+      pltpu.make_async_copy(arr_ref.at[pl.ds(st, width)],
+                            out_ref.at[j], sems.at[j]).wait()
+      return 0
+
+    jax.lax.fori_loop(0, block, start_dma, 0)   # block DMAs in flight
+    jax.lax.fori_loop(0, block, wait_dma, 0)
+
+  grid_spec = pltpu.PrefetchScalarGridSpec(
+      num_scalar_prefetch=1,
+      grid=(n_blocks,),
+      in_specs=[pl.BlockSpec(memory_space=pl.ANY)],   # stays in HBM
+      out_specs=pl.BlockSpec((block, width), lambda i, idx: (i, 0)),
+      scratch_shapes=[pltpu.SemaphoreType.DMA((block,))],
+  )
+  out = pl.pallas_call(
+      kernel,
+      grid_spec=grid_spec,
+      out_shape=jax.ShapeDtypeStruct((s + pad, width), arr.dtype),
+      interpret=interpret,
+  )(starts, arr)
+  return out[:s]
+
+
 @functools.partial(jax.jit, static_argnames=('interpret',))
 def gather_rows(table: jax.Array, rows: jax.Array,
                 interpret: bool = False) -> jax.Array:

@@ -194,3 +194,27 @@ def test_multihop_sample_many_matches_single():
                                table, scratch)
   got = set(np.asarray(out2['node'])[:int(out2['node_count'])].tolist())
   assert got == {7, 8, 9, 10}
+
+
+def test_pallas_gather_windows_parity():
+  from glt_tpu.ops.pallas_kernels import gather_windows
+  rng = np.random.default_rng(3)
+  arr = jnp.asarray(rng.integers(0, 999, 5000).astype(np.int32))
+  starts = jnp.asarray(rng.integers(0, 5000, 37).astype(np.int32))
+  w = 16
+  got = np.asarray(gather_windows(arr, starts, w, interpret=True))
+  st = np.clip(np.asarray(starts), 0, 5000 - w)
+  want = np.stack([np.asarray(arr)[x:x + w] for x in st])
+  np.testing.assert_array_equal(got, want)
+
+
+def test_pallas_gather_windows_block_padding():
+  # row count not a multiple of the block: the pad rows must not leak
+  from glt_tpu.ops.pallas_kernels import gather_windows
+  arr = jnp.arange(100, dtype=jnp.int32)
+  starts = jnp.array([0, 50, 84], jnp.int32)   # 3 rows, block 8
+  got = np.asarray(gather_windows(arr, starts, 16, block=8,
+                                  interpret=True))
+  assert got.shape == (3, 16)
+  np.testing.assert_array_equal(got[0], np.arange(16))
+  np.testing.assert_array_equal(got[2], np.arange(84, 100))
