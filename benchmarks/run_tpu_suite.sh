@@ -10,16 +10,31 @@ mkdir -p "$OUT"
 run() {  # run NAME CMD... — capture json + log, keep going on failure
   local name=$1; shift
   echo "== $name: $*" >&2
-  "$@" > "$OUT/$name.json" 2> "$OUT/$name.log"
+  # hard per-step timeout: a backend-init wedge must cost one step, not
+  # hang the whole unattended suite. These steps are single python
+  # processes, so timeout(1)'s TERM to the direct child suffices.
+  timeout 2400 "$@" > "$OUT/$name.json" 2> "$OUT/$name.log"
+  tail -c 200 "$OUT/$name.json" >&2; echo >&2
+}
+
+run_bench() {  # bench.py steps: self-supervising (child + timeout +
+  # retries), so NO outer timeout — an outer TERM would orphan the
+  # --run grandchild mid-attempt, which can keep the TPU held and
+  # wedge every later step. Bound the supervisor itself via its env
+  # knobs instead (2 attempts x 1200 s ≈ 41 min worst case).
+  local name=$1; shift
+  echo "== $name: $* (self-supervised)" >&2
+  GLT_BENCH_ATTEMPTS=2 GLT_BENCH_TIMEOUT=1200 \
+      "$@" > "$OUT/$name.json" 2> "$OUT/$name.log"
   tail -c 200 "$OUT/$name.json" >&2; echo >&2
 }
 
 # 1. headline engine/scan/PRNG A/Bs (bench.py is supervised + retried)
-run bench_sort_scan4 python bench.py
-run bench_table_scan4 env GLT_DEDUP=table python bench.py
-run bench_sort_scan1 env GLT_BENCH_SCAN=1 python bench.py
-run bench_sort_scan8 env GLT_BENCH_SCAN=8 python bench.py
-run bench_sort_rbg env GLT_PRNG=rbg python bench.py
+run_bench bench_sort_scan4 python bench.py
+run_bench bench_table_scan4 env GLT_DEDUP=table python bench.py
+run_bench bench_sort_scan1 env GLT_BENCH_SCAN=1 python bench.py
+run_bench bench_sort_scan8 env GLT_BENCH_SCAN=8 python bench.py
+run_bench bench_sort_rbg env GLT_PRNG=rbg python bench.py
 
 # 2. primitive economics (incl. sort-engine internals + PRNG A/B)
 run microbench_prims_tpu python benchmarks/microbench_prims.py

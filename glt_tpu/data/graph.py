@@ -87,6 +87,36 @@ class Graph:
     self.lazy_init()
     return self._edge_weights
 
+  def window_arrays(self, width: int, fields=('indices', 'edge_ids',
+                                              'edge_weights')):
+    """Edge arrays padded by ``width`` trailing sentinel elements — the
+    precondition of the Pallas window-DMA gather
+    (ops/pallas_kernels.py::gather_windows): every [start, start+width)
+    window of a real row then lies fully inside the array. Each padded
+    field is an extra device copy of that edge array, so callers name
+    only the fields they read (the weighted path needs just
+    ``edge_weights``); entries are cached per (width, field) and are
+    None where the source array is None.
+    """
+    self.lazy_init()
+    if not hasattr(self, '_window_cache'):
+      self._window_cache = {}
+    import jax.numpy as jnp
+    fills = {'indices': -1, 'edge_ids': -1, 'edge_weights': 0.0}
+    out = {}
+    for f in fields:
+      key = (width, f)
+      if key not in self._window_cache:
+        a = getattr(self, '_' + f)
+        if a is None:
+          self._window_cache[key] = None
+        else:
+          a = jnp.asarray(a)
+          self._window_cache[key] = jnp.concatenate(
+              [a, jnp.full((width,), fills[f], a.dtype)])
+      out[f] = self._window_cache[key]
+    return out
+
   # -- probes (reference graph.cu:30-48 LookupDegreeKernel) ---------------
 
   @property

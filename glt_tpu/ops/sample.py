@@ -132,6 +132,8 @@ def sample_full_neighbors(
     max_degree: int,
     seed_mask: Optional[jax.Array] = None,
     edge_ids: Optional[jax.Array] = None,
+    window_gather=None,
+    window_sources: Optional[dict] = None,
 ) -> NeighborOutput:
   """Full-neighborhood expansion — the reference's ``fanout = -1``
   (csrc/cpu/random_sampler.cc FullSample path; examples/seal_link_pred.py
@@ -139,6 +141,14 @@ def sample_full_neighbors(
   inside a static ``[S, max_degree]`` window; callers pass
   ``max_degree >= graph max degree`` for exact semantics (NeighborSampler
   resolves this automatically). Degrees above the window are truncated.
+
+  ``window_gather``/``window_sources``: optional fast path for the
+  [S, max_degree] window reads (one DMA descriptor per row instead of a
+  per-element slice-gather — ops/pallas_kernels.py::gather_windows).
+  ``window_sources`` must hold the SAME edge arrays padded by
+  ``max_degree`` trailing sentinels (Graph.window_arrays provides them);
+  masked lanes read sentinel values exactly like the XLA path reads
+  clipped garbage.
   """
   assert max_degree > 0
   seeds = seeds.astype(indptr.dtype)
@@ -154,6 +164,13 @@ def sample_full_neighbors(
   deg = jnp.minimum(deg, max_degree)
   win = jnp.arange(max_degree, dtype=jnp.int32)[None, :]   # [1, D]
   mask = win < deg[:, None]
+  if window_gather is not None:
+    nbrs = window_gather(window_sources['indices'], start, max_degree)
+    if edge_ids is not None:
+      eids = window_gather(window_sources['edge_ids'], start, max_degree)
+    else:
+      eids = start[:, None] + win.astype(start.dtype)
+    return NeighborOutput(nbrs=nbrs, mask=mask, eids=eids)
   slots = jnp.clip(start[:, None] + win.astype(start.dtype),
                    0, max(num_edges - 1, 0))
   nbrs = jnp.take(indices, slots, mode='clip')
@@ -172,6 +189,8 @@ def sample_neighbors_weighted(
     max_degree: int,
     seed_mask: Optional[jax.Array] = None,
     edge_ids: Optional[jax.Array] = None,
+    window_gather=None,
+    window_sources: Optional[dict] = None,
 ) -> NeighborOutput:
   """Weight-proportional sampling without replacement via Gumbel-top-k.
 
@@ -179,6 +198,9 @@ def sample_neighbors_weighted(
   hub nodes with more neighbors only the first ``max_degree`` (in
   adjacency order) participate. Pass ``max_degree >= topo.max_degree``
   for exact semantics.
+
+  ``window_gather``/``window_sources``: optional DMA fast path for the
+  [S, max_degree] weight-window read (see sample_full_neighbors).
   """
   assert fanout > 0
   assert fanout <= max_degree, (
@@ -198,9 +220,13 @@ def sample_neighbors_weighted(
 
   win = jnp.arange(max_degree, dtype=jnp.int32)[None, :]  # [1, D]
   valid = win < deg[:, None]                               # [S, D]
-  slots = jnp.clip(start[:, None] + win.astype(start.dtype),
-                   0, max(num_edges - 1, 0))
-  w = jnp.take(weights, slots, mode='clip').astype(jnp.float32)
+  if window_gather is not None:
+    w = window_gather(window_sources['edge_weights'], start,
+                      max_degree).astype(jnp.float32)
+  else:
+    slots = jnp.clip(start[:, None] + win.astype(start.dtype),
+                     0, max(num_edges - 1, 0))
+    w = jnp.take(weights, slots, mode='clip').astype(jnp.float32)
   w = jnp.where(valid & (w > 0), w, 0.0)
   g = -jnp.log(-jnp.log(
       jax.random.uniform(key, w.shape, minval=1e-20, maxval=1.0)))

@@ -168,19 +168,35 @@ class NeighborSampler(BaseSampler):
       self._tables[ntype] = make_dedup_tables(num_nodes)
     return self._tables[ntype]
 
+  def _window_kwargs(self, g: Graph, width: int, fields):
+    """Opt-in Pallas DMA window-gather plumbing for the [S, width]
+    window reads of the full/weighted paths (GLT_USE_PALLAS=1 on TPU;
+    tests inject an interpret-mode gather via ``_window_gather_fn``)."""
+    fn = getattr(self, '_window_gather_fn', None)
+    if fn is None:
+      from ..ops.pallas_kernels import gather_windows, use_pallas_default
+      if not use_pallas_default():
+        return {}
+      fn = gather_windows
+    return dict(window_gather=lambda arr, st, w: fn(arr, st, width=w),
+                window_sources=g.window_arrays(width, fields))
+
   def _one_hop(self, g: Graph, frontier, fanout, key, mask):
     """Dispatch full/uniform/weighted one-hop sampling on graph ``g``."""
     eids = g.edge_ids if self.with_edge else None
     if fanout < 0:  # full neighborhood inside a |fanout|-wide window
       return sample_full_neighbors(
           g.indptr, g.indices, frontier, -fanout, seed_mask=mask,
-          edge_ids=eids)
+          edge_ids=eids, **self._window_kwargs(
+              g, -fanout, ('indices', 'edge_ids') if eids is not None
+              else ('indices',)))
     if self.with_weight and g.edge_weights is not None:
       max_deg = self.max_weighted_degree or g.topo.max_degree
       max_deg = max(max_deg, fanout)
       return sample_neighbors_weighted(
           g.indptr, g.indices, g.edge_weights, frontier, fanout, key,
-          max_degree=max_deg, seed_mask=mask, edge_ids=eids)
+          max_degree=max_deg, seed_mask=mask, edge_ids=eids,
+          **self._window_kwargs(g, max_deg, ('edge_weights',)))
     return sample_neighbors(
         g.indptr, g.indices, frontier, fanout, key, seed_mask=mask,
         edge_ids=eids, replace=self.replace)
