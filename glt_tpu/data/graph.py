@@ -100,21 +100,26 @@ class Graph:
     """
     self.lazy_init()
     if not hasattr(self, '_window_cache'):
-      self._window_cache = {}
+      self._window_cache = {}   # field -> (padded_width, array)
     import jax.numpy as jnp
     fills = {'indices': -1, 'edge_ids': -1, 'edge_weights': 0.0}
     out = {}
     for f in fields:
-      key = (width, f)
-      if key not in self._window_cache:
+      have = self._window_cache.get(f)
+      # one padded copy per FIELD, grown to the max width ever asked:
+      # containment (start + w <= len) holds for every w <= padded
+      # width, so distinct hop widths share the copy instead of each
+      # materializing another full-edge-array duplicate
+      if have is None or have[0] < width:
         a = getattr(self, '_' + f)
         if a is None:
-          self._window_cache[key] = None
+          have = (width, None)
         else:
           a = jnp.asarray(a)
-          self._window_cache[key] = jnp.concatenate(
-              [a, jnp.full((width,), fills[f], a.dtype)])
-      out[f] = self._window_cache[key]
+          have = (width, jnp.concatenate(
+              [a, jnp.full((width,), fills[f], a.dtype)]))
+        self._window_cache[f] = have
+      out[f] = have[1]
     return out
 
   # -- probes (reference graph.cu:30-48 LookupDegreeKernel) ---------------

@@ -39,7 +39,12 @@ class DistFeature:
   """
 
   def __init__(self, mesh: Mesh, parts: Sequence, feat_pb,
-               num_ids: int, axis: str = 'data', dtype=None):
+               num_ids: int, axis: str = 'data', dtype=None,
+               row_gather=None):
+    # row_gather: optional serving-gather override (see
+    # parallel.ShardedFeature); must be set before the first lookup —
+    # the jitted shard_map traces it in on first call
+    self._row_gather = row_gather
     self.mesh = mesh
     self.axis = axis
     self.num_ids = int(num_ids)
@@ -96,11 +101,15 @@ class DistFeature:
     rows = jnp.take(map_shard, jnp.clip(flat, 0, self.num_ids - 1),
                     mode='clip')
     ok = (flat >= 0) & (rows >= 0)
-    served = jnp.where(
-        ok[:, None],
-        jnp.take(feat_shard, jnp.clip(rows, 0, self.rows_max - 1),
-                 axis=0),
-        0)
+    safe_rows = jnp.clip(rows, 0, self.rows_max - 1)
+    from ..ops.pallas_kernels import resolve_row_gather
+    gather = resolve_row_gather(self._row_gather)
+    if gather is not None:   # per-row DMA serving gather (see
+      #                        parallel.ShardedFeature.lookup_local)
+      rows_out = gather(feat_shard, safe_rows)
+    else:
+      rows_out = jnp.take(feat_shard, safe_rows, axis=0)
+    served = jnp.where(ok[:, None], rows_out, 0)
     resp = all_to_all(served.reshape(n, -1, self.feature_dim), ax)
     return unbucket(resp, meta, n)
 
@@ -126,7 +135,7 @@ class DistFeature:
   @classmethod
   def from_dist_datasets(cls, mesh: Mesh, datasets, ntype=None,
                          axis: str = 'data', dtype=None,
-                         kind: str = 'node'):
+                         kind: str = 'node', row_gather=None):
     """Single-host simulation: build from every partition's DistDataset
     (features must be fully device-resident).
 
@@ -152,7 +161,8 @@ class DistFeature:
       pbs.append(pb)
       num_ids = max(num_ids, pb.table.shape[0])
       parts.append((np.asarray(feat.device_part), feat._id2index))
-    return cls(mesh, parts, pbs, num_ids, axis=axis, dtype=dtype)
+    return cls(mesh, parts, pbs, num_ids, axis=axis, dtype=dtype,
+               row_gather=row_gather)
 
 
 def dist_feature_from_partitions_multihost(mesh, root_dir: str,

@@ -37,6 +37,18 @@ def use_pallas_default() -> bool:
           and jax.default_backend() == 'tpu')
 
 
+def resolve_row_gather(override=None):
+  """Gather-selection policy shared by every feature-serving path:
+  an explicit override (tests inject the interpret-mode kernel) wins;
+  otherwise the Pallas row-DMA gather when GLT_USE_PALLAS is on and the
+  backend supports it; otherwise None (callers fall back to jnp.take)."""
+  if override is not None:
+    return override
+  if use_pallas_default():
+    return gather_rows
+  return None
+
+
 @functools.partial(jax.jit, static_argnames=('width', 'block',
                                              'interpret'))
 def gather_windows(arr: jax.Array, starts: jax.Array, width: int,

@@ -34,7 +34,12 @@ class ShardedFeature:
   partition/partition_book.py:6-47).
   """
 
-  def __init__(self, feats, mesh: Mesh, axis: str = 'data', dtype=None):
+  def __init__(self, feats, mesh: Mesh, axis: str = 'data', dtype=None,
+               row_gather=None):
+    # row_gather: optional (shard [R, D], rows [M]) -> [M, D] override
+    # for the serving gather — tests inject the interpret-mode Pallas
+    # kernel; on TPU GLT_USE_PALLAS=1 selects it automatically
+    self._row_gather = row_gather
     feats = as_numpy(feats)
     self.mesh = mesh
     self.axis = axis
@@ -103,11 +108,18 @@ class ShardedFeature:
     local_rows = req_in - my_index * self.rows_per_shard
     ok = (local_rows >= 0) & (local_rows < self.rows_per_shard) & \
         (req_in >= 0)
-    served = jnp.where(
-        ok[..., None],
-        jnp.take(local_shard, jnp.clip(local_rows, 0,
-                                       self.rows_per_shard - 1), axis=0),
-        0)
+    safe_rows = jnp.clip(local_rows, 0, self.rows_per_shard - 1)
+    # one DMA descriptor per served row instead of XLA's
+    # per-output-element gather (the UnifiedTensor GatherTensorKernel
+    # analogue, done the TPU way), when enabled
+    from ..ops.pallas_kernels import resolve_row_gather
+    gather = resolve_row_gather(self._row_gather)
+    if gather is not None:
+      rows_out = gather(local_shard, safe_rows.reshape(-1)).reshape(
+          safe_rows.shape + (self.feature_dim,))
+    else:
+      rows_out = jnp.take(local_shard, safe_rows, axis=0)
+    served = jnp.where(ok[..., None], rows_out, 0)
     # send responses back; row p now holds our requests served by peer p
     resp = jax.lax.all_to_all(served, ax, split_axis=0, concat_axis=0,
                               tiled=False)

@@ -838,3 +838,17 @@ def test_dist_hetero_sampler_sort_engine(tmp_path_factory, mesh,
     got = set(items[p][:icount[p]].tolist())
     assert got == expect, f'dev {p}: {got} != {expect}'
   assert ('item', 'rev_u2i', 'user') in out['row']
+
+
+def test_dist_feature_pallas_row_gather_parity(mesh, dist_datasets):
+  # injected interpret-mode Pallas serving gather == XLA take through
+  # the PB-routed all_to_all lookup
+  import functools
+  from glt_tpu.ops.pallas_kernels import gather_rows
+  base = DistFeature.from_dist_datasets(mesh, dist_datasets)
+  fast = DistFeature.from_dist_datasets(
+      mesh, dist_datasets,
+      row_gather=functools.partial(gather_rows, interpret=True))
+  ids = np.random.default_rng(1).integers(0, N_NODES, N_PARTS * 16)
+  np.testing.assert_array_equal(np.asarray(base.lookup(ids)),
+                                np.asarray(fast.lookup(ids)))

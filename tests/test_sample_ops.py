@@ -280,3 +280,48 @@ def test_window_dma_path_matches_xla_full_neighborhood(monkeypatch,
   a, b = run(False), run(True)
   for k in a:
     np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+@pytest.mark.parametrize('fanouts', [[-1, -1], [3]])
+def test_window_dma_variable_degree_mask_sanitized(monkeypatch, fanouts):
+  """Variable-degree graph: short windows DO read sentinel lanes in the
+  DMA path (unlike the uniform ring). Valid lanes must match the XLA
+  path exactly; masked lanes are contractually unspecified, so the
+  comparison sanitizes them with the mask first."""
+  import functools
+  from glt_tpu.data import Dataset
+  from glt_tpu.ops.pallas_kernels import gather_windows
+  from glt_tpu.sampler import NeighborSampler
+
+  rng = np.random.default_rng(11)
+  n = 30
+  edges = set()
+  for v in range(n):                     # degrees 0..6
+    for w in rng.choice(n, int(rng.integers(0, 7)), replace=False):
+      if int(w) != v:
+        edges.add((v, int(w)))
+  ei = np.array(sorted(edges)).T
+  ds = Dataset(edge_dir='out')
+  ds.init_graph(edge_index=ei, num_nodes=n,
+                edge_weights=(np.arange(ei.shape[1]) % 5 + 1
+                              ).astype(np.float32))
+  seeds = np.arange(0, n, 4)
+  weighted = fanouts == [3]
+
+  def run(inject):
+    s = NeighborSampler(ds.get_graph(), fanouts, with_edge=True,
+                        with_weight=weighted, seed=5)
+    if inject:
+      s._window_gather_fn = functools.partial(gather_windows,
+                                              interpret=True)
+    out = s.sample_from_nodes(seeds, key=jax.random.key(7))
+    m = np.asarray(out.edge_mask)
+    return dict(
+        node=np.asarray(out.node), count=int(out.node_count), mask=m,
+        row=np.where(m, np.asarray(out.row), -1),
+        col=np.where(m, np.asarray(out.col), -1),
+        edge=np.where(m, np.asarray(out.edge), -1))
+
+  a, b = run(False), run(True)
+  for k in a:
+    np.testing.assert_array_equal(a[k], b[k], err_msg=k)
