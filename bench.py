@@ -16,18 +16,24 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Run modes
 ---------
-``python bench.py``        supervisor: runs the measurement in a child
-                           process with a timeout, retrying with backoff
-                           when TPU backend init fails or wedges (the
-                           known axon-tunnel failure mode). Always emits
-                           one JSON line — on unrecoverable failure the
-                           line carries ``value: 0.0`` and an ``error``
-                           field instead of a stack trace.
-``python bench.py --run``  worker: the actual measurement (may hang if
-                           the tunnel is wedged; the supervisor guards).
+``python bench.py``         supervisor: probes the backend with a cheap
+                            short-timeout child first (the known axon
+                            failure mode is a silent hang in backend
+                            init — pay 90 s to find out, not a full
+                            attempt), then runs the measurement child
+                            under a HARD TOTAL BUDGET. Always emits one
+                            JSON line within the budget — on failure the
+                            line carries ``value: 0.0`` and an ``error``
+                            field instead of a stack trace.
+``python bench.py --probe`` backend probe: init jax, list devices, exit.
+``python bench.py --run``   worker: the actual measurement (may hang if
+                            the tunnel is wedged; the supervisor guards).
 
-Env knobs: GLT_BENCH_ATTEMPTS (default 4), GLT_BENCH_TIMEOUT seconds per
-attempt (default 1500), GLT_BENCH_SCAN (batches fused per device call,
+Env knobs: GLT_BENCH_BUDGET total wall-clock seconds for the supervisor
+(default 900 — sized well under the driver's observed ~1500 s kill
+window so the structured line always lands), GLT_BENCH_PROBE_TIMEOUT
+(default 90), GLT_BENCH_TIMEOUT seconds per measurement attempt
+(default: fit budget), GLT_BENCH_SCAN (batches fused per device call,
 default 4), GLT_BENCH_PLATFORM (force a jax platform, e.g. ``cpu``).
 """
 import json
@@ -140,54 +146,123 @@ def run_worker():
         backend=dev.platform, scan=scan, iters=ITERS, batch=BATCH)
 
 
-def run_supervisor():
-  attempts = int(os.environ.get('GLT_BENCH_ATTEMPTS', '4'))
-  timeout = float(os.environ.get('GLT_BENCH_TIMEOUT', '1500'))
-  backoffs = [20, 60, 120]
-  last_err = 'unknown'
-  for attempt in range(attempts):
+def run_probe():
+  """Cheap backend liveness check: init jax + list devices, nothing else.
+  A wedged axon tunnel hangs here — the supervisor's short timeout turns
+  that hang into a fast, cheap verdict."""
+  import jax
+  platform = os.environ.get('GLT_BENCH_PLATFORM')
+  if platform:
+    jax.config.update('jax_platforms', platform)
+  dev = jax.devices()[0]
+  print(f'probe-ok {dev.platform} {dev.device_kind}')
+
+
+def _child(mode, timeout):
+  """Run a child in its own process group; on timeout SIGKILL the whole
+  group (subprocess.run's TimeoutExpired kills only the direct child —
+  a surviving grandchild would both hold the TPU and keep the stdout
+  pipe open, hanging the supervisor in communicate())."""
+  import signal
+  proc = subprocess.Popen(
+      [sys.executable, os.path.abspath(__file__), mode],
+      stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+      start_new_session=True)
+  try:
+    out, err = proc.communicate(timeout=timeout)
+  except subprocess.TimeoutExpired:
     try:
-      proc = subprocess.run(
-          [sys.executable, os.path.abspath(__file__), '--run'],
-          capture_output=True, text=True, timeout=timeout)
-    except subprocess.TimeoutExpired:
-      last_err = f'timeout after {timeout}s (wedged backend?)'
-      print(f'# attempt {attempt + 1}/{attempts}: {last_err}',
+      os.killpg(proc.pid, signal.SIGKILL)  # pgid == pid (new session)
+    except (ProcessLookupError, PermissionError):
+      proc.kill()
+    try:
+      proc.communicate(timeout=10)
+    except Exception:
+      pass
+    return None, f'timeout after {timeout:.0f}s (wedged backend?)'
+  proc.stdout, proc.stderr = out, err
+  return proc, None
+
+
+def run_supervisor():
+  t0 = time.time()
+  budget = float(os.environ.get('GLT_BENCH_BUDGET', '900'))
+  probe_timeout = float(os.environ.get('GLT_BENCH_PROBE_TIMEOUT', '90'))
+  deadline = t0 + budget
+  last_err = 'unknown'
+
+  def remaining():
+    return deadline - time.time()
+
+  # Phase 1: backend probe — up to 2 tries, small cost each.
+  probe_ok = False
+  for attempt in range(2):
+    if remaining() < probe_timeout + 60:
+      break  # keep enough budget for the failure line + one attempt
+    proc, err = _child('--probe', probe_timeout)
+    if proc is not None and proc.returncode == 0 \
+        and 'probe-ok' in proc.stdout:
+      print(f'# {proc.stdout.strip()} ({time.time() - t0:.0f}s)',
             file=sys.stderr)
-    else:
-      line = next((l for l in reversed(proc.stdout.splitlines())
-                   if l.startswith('{')), None)
-      if proc.returncode == 0 and line:
-        print(line)
-        return 0
-      tail = (proc.stderr or proc.stdout).strip().splitlines()[-8:]
-      last_err = (f'rc={proc.returncode}: ' + ' | '.join(tail))[:800]
-      print(f'# attempt {attempt + 1}/{attempts} failed: {last_err}',
-            file=sys.stderr)
-      # Only backend-init/tunnel failures are transient; a deterministic
-      # error (ImportError, bad config, assertion) would fail identically
-      # on retry — emit the failure line now instead of burning backoffs.
-      transient = ('initialize backend' in last_err
-                   or 'UNAVAILABLE' in last_err
-                   or 'DEADLINE' in last_err
-                   or 'RESOURCE_EXHAUSTED' in last_err
-                   or 'axon' in last_err.lower())
-      if not transient:
-        break
-    if attempt < attempts - 1:
-      delay = backoffs[min(attempt, len(backoffs) - 1)]
-      print(f'# backing off {delay}s before retry', file=sys.stderr)
-      time.sleep(delay)
+      probe_ok = True
+      break
+    last_err = err or (f'probe rc={proc.returncode}: '
+                       + (proc.stderr or proc.stdout).strip()[-300:])
+    print(f'# probe attempt {attempt + 1}/2 failed: {last_err}',
+          file=sys.stderr)
+    if attempt == 0 and remaining() > probe_timeout + 120:
+      time.sleep(20)
+  if not probe_ok:
+    _emit(0.0, 0.0, error=f'backend probe failed: {last_err}')
+    return 0
+
+  # Phase 2: measurement attempts within the remaining budget.
+  env_timeout = os.environ.get('GLT_BENCH_TIMEOUT')
+  while remaining() > 120:
+    timeout = remaining() - 30
+    if env_timeout:
+      timeout = min(timeout, float(env_timeout))
+    proc, err = _child('--run', timeout)
+    if proc is None:
+      last_err = err
+      print(f'# measurement: {last_err}', file=sys.stderr)
+      if not env_timeout:
+        break  # the attempt consumed the whole remaining budget
+      if remaining() > 180:
+        time.sleep(20)   # short-capped attempt: budget remains, retry
+      continue
+    line = next((l for l in reversed(proc.stdout.splitlines())
+                 if l.startswith('{')), None)
+    if proc.returncode == 0 and line:
+      print(line)
+      return 0
+    tail = (proc.stderr or proc.stdout).strip().splitlines()[-8:]
+    last_err = (f'rc={proc.returncode}: ' + ' | '.join(tail))[:800]
+    print(f'# measurement failed: {last_err}', file=sys.stderr)
+    # Only backend-init/tunnel failures are transient; a deterministic
+    # error (ImportError, bad config, assertion) would fail identically
+    # on retry — emit the failure line now instead of burning budget.
+    transient = ('initialize backend' in last_err
+                 or 'UNAVAILABLE' in last_err
+                 or 'DEADLINE' in last_err
+                 or 'RESOURCE_EXHAUSTED' in last_err
+                 or 'axon' in last_err.lower())
+    if not transient:
+      break
+    if remaining() > 180:
+      time.sleep(20)
   # Unrecoverable: still emit the structured line so the driver records
   # a parseable failure instead of a stack trace. value 0.0 + error
   # field unambiguously marks "not measured", not "measured as 0".
-  _emit(0.0, 0.0, error=f'backend unavailable after {attempts} '
-        f'attempts: {last_err}')
+  _emit(0.0, 0.0, error=f'not measured within {budget:.0f}s budget: '
+        f'{last_err}')
   return 0
 
 
 if __name__ == '__main__':
   if '--run' in sys.argv:
     run_worker()
+  elif '--probe' in sys.argv:
+    run_probe()
   else:
     sys.exit(run_supervisor())

@@ -17,17 +17,22 @@ run() {  # run NAME CMD... — capture json + log, keep going on failure
   tail -c 200 "$OUT/$name.json" >&2; echo >&2
 }
 
-run_bench() {  # bench.py steps: self-supervising (child + timeout +
-  # retries), so NO outer timeout — an outer TERM would orphan the
-  # --run grandchild mid-attempt, which can keep the TPU held and
-  # wedge every later step. Bound the supervisor itself via its env
-  # knobs instead (2 attempts x 1200 s ≈ 41 min worst case).
+run_bench() {  # bench.py steps: self-supervising (probe child + budget),
+  # so NO outer timeout — an outer TERM would orphan the --run
+  # grandchild mid-attempt, which can keep the TPU held and wedge every
+  # later step. The supervisor probes the backend with a 90 s child
+  # before paying for a full attempt and bounds its own total wall
+  # clock, so a wedged tunnel costs ~2 min per step, not 40.
   local name=$1; shift
   echo "== $name: $* (self-supervised)" >&2
-  GLT_BENCH_ATTEMPTS=2 GLT_BENCH_TIMEOUT=1200 \
+  GLT_BENCH_BUDGET=700 \
       "$@" > "$OUT/$name.json" 2> "$OUT/$name.log"
   tail -c 200 "$OUT/$name.json" >&2; echo >&2
 }
+
+# 0. prime the persistent compile cache (first compile is the slow one;
+# bench.py and the driver's end-of-round run share .jax_cache)
+run_bench cache_prime python bench.py
 
 # 1. headline engine/scan/PRNG A/Bs (bench.py is supervised + retried)
 run_bench bench_sort_scan4 python bench.py

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Poll the axon tunnel; run the full TPU suite as soon as it answers.
+# The tunnel wedges for minutes-to-hours at a time, so perf evidence
+# collection must be opportunistic: probe cheaply (90 s child) on an
+# interval, fire run_tpu_suite.sh on the first success, and stop.
+# Usage: nohup benchmarks/tpu_watch.sh [interval_s] & (default 600)
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL=${1:-600}
+OUT=benchmarks/tpu_runs
+mkdir -p "$OUT"
+while true; do
+  if GLT_BENCH_PROBE_TIMEOUT=90 timeout 120 \
+      python bench.py --probe > "$OUT/probe.log" 2>&1; then
+    echo "$(date -Is) tunnel alive; starting suite" >> "$OUT/watch.log"
+    bash benchmarks/run_tpu_suite.sh >> "$OUT/watch.log" 2>&1
+    echo "$(date -Is) suite finished" >> "$OUT/watch.log"
+    exit 0
+  fi
+  echo "$(date -Is) tunnel wedged; retry in ${INTERVAL}s" \
+      >> "$OUT/watch.log"
+  sleep "$INTERVAL"
+done

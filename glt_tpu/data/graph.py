@@ -45,6 +45,9 @@ class Graph:
     self._edge_ids = None
     self._edge_weights = None
     self._initialized = False
+    import threading
+    self._window_cache = {}   # field -> (padded_width, array)
+    self._window_lock = threading.Lock()
 
   # -- lazy init ---------------------------------------------------------
 
@@ -98,28 +101,33 @@ class Graph:
     ``edge_weights``); entries are cached per (width, field) and are
     None where the source array is None.
     """
+    if self.mode != GraphMode.HBM:
+      # jnp.concatenate below would silently device-place a HOST-mode
+      # (beyond-HBM) edge array, defeating the residency mode; the
+      # window-DMA path requires device-resident topology, so samplers
+      # fall back to the XLA gather when this returns None fields.
+      return {f: None for f in fields}
     self.lazy_init()
-    if not hasattr(self, '_window_cache'):
-      self._window_cache = {}   # field -> (padded_width, array)
     import jax.numpy as jnp
     fills = {'indices': -1, 'edge_ids': -1, 'edge_weights': 0.0}
     out = {}
-    for f in fields:
-      have = self._window_cache.get(f)
-      # one padded copy per FIELD, grown to the max width ever asked:
-      # containment (start + w <= len) holds for every w <= padded
-      # width, so distinct hop widths share the copy instead of each
-      # materializing another full-edge-array duplicate
-      if have is None or have[0] < width:
-        a = getattr(self, '_' + f)
-        if a is None:
-          have = (width, None)
-        else:
-          a = jnp.asarray(a)
-          have = (width, jnp.concatenate(
-              [a, jnp.full((width,), fills[f], a.dtype)]))
-        self._window_cache[f] = have
-      out[f] = have[1]
+    with self._window_lock:
+      for f in fields:
+        have = self._window_cache.get(f)
+        # one padded copy per FIELD, grown to the max width ever asked:
+        # containment (start + w <= len) holds for every w <= padded
+        # width, so distinct hop widths share the copy instead of each
+        # materializing another full-edge-array duplicate
+        if have is None or have[0] < width:
+          a = getattr(self, '_' + f)
+          if a is None:
+            have = (width, None)
+          else:
+            a = jnp.asarray(a)
+            have = (width, jnp.concatenate(
+                [a, jnp.full((width,), fills[f], a.dtype)]))
+          self._window_cache[f] = have
+        out[f] = have[1]
     return out
 
   # -- probes (reference graph.cu:30-48 LookupDegreeKernel) ---------------

@@ -40,24 +40,40 @@ class DistFeature:
 
   def __init__(self, mesh: Mesh, parts: Sequence, feat_pb,
                num_ids: int, axis: str = 'data', dtype=None,
-               row_gather=None):
-    # row_gather: optional serving-gather override (see
-    # parallel.ShardedFeature); must be set before the first lookup —
-    # the jitted shard_map traces it in on first call
-    self._row_gather = row_gather
-    self.mesh = mesh
-    self.axis = axis
-    self.num_ids = int(num_ids)
+               row_gather=None, split_ratio: float = 1.0,
+               hot_counts: Optional[Sequence[int]] = None,
+               cold_fetcher=None):
     n_parts = len(parts)
     assert mesh.shape[axis] == n_parts
     rows_max = max(max(f.shape[0] for f, _ in parts), 1)
-    self.feature_dim = parts[0][0].shape[1]
-    feats_l, maps_l = [], []
-    for feats, id2index in parts:
+    if hot_counts is None:
+      hot_counts = [int(round(f.shape[0] * float(split_ratio)))
+                    for f, _ in parts]
+    spill = any(h < f.shape[0] for h, (f, _) in zip(hot_counts, parts))
+    self._finish_init(mesh, axis, num_ids, parts[0][0].shape[1],
+                      rows_max, n_parts, row_gather=row_gather,
+                      hot_counts=hot_counts, cold_fetcher=cold_fetcher,
+                      spill=spill)
+    if not isinstance(feat_pb, (list, tuple)):
+      feat_pb = [feat_pb] * n_parts
+    feats_l, maps_l, pbs_l = [], [], []
+    for p, (feats, id2index) in enumerate(parts):
       feats = as_numpy(feats)
       if dtype is not None:
         feats = feats.astype(dtype)
-      pad = rows_max - feats.shape[0]
+      hot = self.hot_counts[p]
+      pb_dense = _pb_dense(feat_pb[p], self.num_ids)
+      pbs_l.append(pb_dense)
+      if self._spill:
+        # every local partition keeps its host routing book: a
+        # fully-resident requester can still route a lane to a spilled
+        # owner, and the host phase resolves by the requester's book
+        self._host_pb[p] = pb_dense
+      if hot < feats.shape[0]:   # spill: cold rows stay host-resident
+        self._host_cold[p] = feats[hot:]
+        self._host_id2index[p] = as_numpy(id2index).astype(np.int32)
+      feats = feats[:hot]
+      pad = self.hot_max - feats.shape[0]
       if pad:
         feats = np.concatenate(
             [feats, np.zeros((pad, feats.shape[1]), feats.dtype)])
@@ -68,29 +84,63 @@ class DistFeature:
       feats_l.append(feats)
       maps_l.append(m[:self.num_ids])
     shard = NamedSharding(mesh, P(axis))
-    self.array = jax.device_put(np.stack(feats_l), shard)   # [P, R, D]
+    self.array = jax.device_put(np.stack(feats_l), shard)  # [P, Rh, D]
     self.id2index = jax.device_put(np.stack(maps_l), shard)  # [P, N]
-    if not isinstance(feat_pb, (list, tuple)):
-      feat_pb = [feat_pb] * n_parts
-    self.feat_pb = jax.device_put(
-        np.stack([_pb_dense(pb, self.num_ids) for pb in feat_pb]),
-        shard)                                               # [P, N]
-    self.rows_max = rows_max
-    self.num_partitions = n_parts
+    self.feat_pb = jax.device_put(np.stack(pbs_l), shard)    # [P, N]
+
+  def _finish_init(self, mesh: Mesh, axis: str, num_ids: int,
+                   feat_dim: int, rows_max: int, n_parts: int,
+                   row_gather=None, hot_counts=None, cold_fetcher=None,
+                   spill=None):
+    """Non-array state shared by __init__ and every alternate builder.
+    ANY new scalar/config field must be set here, so a builder that
+    assembles the arrays differently (e.g. the multihost
+    process-local path) can never miss it."""
+    # row_gather: optional serving-gather override (see
+    # parallel.ShardedFeature); must be set before the first lookup —
+    # the jitted shard_map traces it in on first call
+    self._row_gather = row_gather
+    self.mesh = mesh
+    self.axis = axis
+    self.num_ids = int(num_ids)
+    self.feature_dim = int(feat_dim)
+    self.rows_max = int(rows_max)
+    self.num_partitions = int(n_parts)
+    # host-spill state (UnifiedTensor pinned-CPU shard analogue,
+    # reference unified_tensor.cu:202-231): rows [hot_p, R_p) of each
+    # partition's block stay in that process's host RAM. hot_counts ==
+    # rows_max everywhere (the default) means fully device-resident.
+    if hot_counts is None:
+      hot_counts = [rows_max] * n_parts
+    self.hot_counts = np.asarray(hot_counts, np.int32)
+    self.hot_max = max(1, int(self.hot_counts.max()))
+    if spill is None:
+      spill = bool((self.hot_counts < rows_max).any())
+    self._spill = spill
+    self._host_cold = {}      # part -> np [R_p - hot_p, D]
+    self._host_id2index = {}  # part -> np [N] (local partitions only)
+    self._host_pb = {}        # part -> np [N] requester routing book
+    self._cold_fetcher = cold_fetcher
+    self._hot_counts_dev = jnp.asarray(self.hot_counts)
     # compiled once; rebuilding shard_map per call would re-trace
     self._lookup_fn = jax.jit(jax.shard_map(
         lambda f, m, pb, i, v: self.lookup_local(f[0], m[0], pb[0], i, v),
         mesh=self.mesh,
         in_specs=(P(self.axis), P(self.axis), P(self.axis), P(self.axis),
                   P(self.axis)),
-        out_specs=P(self.axis), check_vma=False))
+        out_specs=(P(self.axis) if not self._spill
+                   else (P(self.axis), P(self.axis))), check_vma=False))
 
   # -- in-shard lookup (call inside shard_map) ---------------------------
 
   def lookup_local(self, feat_shard, map_shard, pb, ids, valid,
-                   axis_name: Optional[str] = None) -> jax.Array:
-    """feat_shard: [R, D] block; map_shard: [N]; pb: [N] — THIS device's
-    routing book; ids/valid: [B]. Returns [B, D] (zeros where invalid)."""
+                   axis_name: Optional[str] = None):
+    """feat_shard: [Rh, D] hot block; map_shard: [N]; pb: [N] — THIS
+    device's routing book; ids/valid: [B]. Returns [B, D] (zeros where
+    invalid). With host spill active, returns ([B, D], cold_flag [B]):
+    flagged lanes are valid ids whose row lives in the owner's host
+    shard — served as zeros here and resolved by lookup()'s host
+    phase."""
     ax = axis_name or self.axis
     n = self.num_partitions
     owner = jnp.take(pb, jnp.clip(ids, 0, self.num_ids - 1), mode='clip')
@@ -101,7 +151,11 @@ class DistFeature:
     rows = jnp.take(map_shard, jnp.clip(flat, 0, self.num_ids - 1),
                     mode='clip')
     ok = (flat >= 0) & (rows >= 0)
-    safe_rows = jnp.clip(rows, 0, self.rows_max - 1)
+    if self._spill:
+      my_hot = jnp.take(self._hot_counts_dev, jax.lax.axis_index(ax))
+      cold = ok & (rows >= my_hot)
+      ok = ok & (rows < my_hot)
+    safe_rows = jnp.clip(rows, 0, self.hot_max - 1)
     from ..ops.pallas_kernels import resolve_row_gather
     gather = resolve_row_gather(self._row_gather)
     if gather is not None:   # per-row DMA serving gather (see
@@ -110,16 +164,100 @@ class DistFeature:
     else:
       rows_out = jnp.take(feat_shard, safe_rows, axis=0)
     served = jnp.where(ok[:, None], rows_out, 0)
-    resp = all_to_all(served.reshape(n, -1, self.feature_dim), ax)
-    return unbucket(resp, meta, n)
+    if not self._spill:
+      resp = all_to_all(served.reshape(n, -1, self.feature_dim), ax)
+      return unbucket(resp, meta, n)
+    # ride the cold flag back as one extra response column so the
+    # requester learns hot/cold without holding the owner's id2index
+    payload = jnp.concatenate(
+        [served, cold[:, None].astype(served.dtype)], axis=1)
+    resp = all_to_all(payload.reshape(n, -1, self.feature_dim + 1), ax)
+    full = unbucket(resp, meta, n)
+    return full[:, :self.feature_dim], full[:, self.feature_dim] > 0
 
   def lookup(self, ids, valid=None) -> jax.Array:
     """Whole-mesh lookup: ids [P * B] shard-major."""
-    ids = jnp.asarray(as_numpy(ids), jnp.int32)
+    ids_np = as_numpy(ids).astype(np.int64)
+    ids = jnp.asarray(ids_np, jnp.int32)
     if valid is None:
       valid = jnp.ones(ids.shape, bool)
-    return self._lookup_fn(self.array, self.id2index, self.feat_pb, ids,
-                           jnp.asarray(valid))
+    out = self._lookup_fn(self.array, self.id2index, self.feat_pb, ids,
+                          jnp.asarray(valid))
+    if not self._spill:
+      return out
+    out, flag = out
+    return self._resolve_cold(out, flag, ids_np)
+
+  # -- host spill resolution ---------------------------------------------
+
+  def _resolve_cold(self, out, flag, ids_np) -> jax.Array:
+    """Serve the flagged lanes from the host shards and merge on device.
+    Cold lanes are zero in ``out`` (the device phase masks them), so the
+    merge is one sharded add — no SPMD-hostile scatter. Remote-process
+    partitions resolve through ``cold_fetcher(part, ids) -> [M, D]``
+    (e.g. an rpc callee); local ones read the in-process block."""
+    b = ids_np.shape[0] // self.num_partitions
+    lanes = []
+    for s in flag.addressable_shards:
+      blk = np.asarray(s.data)
+      start = s.index[0].start or 0
+      nz = np.nonzero(blk)[0]
+      if nz.size:
+        lanes.append(start + nz)
+    if not lanes:
+      return out
+    lanes = np.concatenate(lanes)
+    cold_ids = ids_np[lanes]
+    dev_of = lanes // b
+    owners = np.empty(lanes.shape[0], np.int64)
+    for d in np.unique(dev_of):
+      m = dev_of == d
+      book = self._host_pb.get(int(d))
+      if book is None:
+        raise RuntimeError(
+            f'cold lane routed by partition {d} but its host routing '
+            'book is not in this process — build the store with '
+            'host-spill in the owning process')
+      owners[m] = book[np.clip(cold_ids[m], 0, self.num_ids - 1)]
+    np_dtype = np.dtype(out.dtype)
+    vals = np.zeros((lanes.shape[0], self.feature_dim), np_dtype)
+    for p in np.unique(owners):
+      m = owners == p
+      p = int(p)
+      if p in self._host_cold:
+        rows = self._host_id2index[p][cold_ids[m]]
+        vals[m] = self._host_cold[p][rows - int(self.hot_counts[p])]
+      elif self._cold_fetcher is not None:
+        vals[m] = self._cold_fetcher(p, cold_ids[m])
+      else:
+        raise RuntimeError(
+            f'partition {p} holds cold rows in another process and no '
+            'cold_fetcher is registered (see set_cold_fetcher)')
+    delta = np.zeros((ids_np.shape[0], self.feature_dim), np_dtype)
+    delta[lanes] = vals
+    if jax.process_count() == 1:
+      delta_arr = jax.device_put(delta, out.sharding)
+    else:
+      from ..parallel.multihost import global_from_local
+      local = np.concatenate(
+          [delta[d * b:(d + 1) * b]
+           for d, dev in enumerate(self.mesh.devices.reshape(-1))
+           if dev.process_index == jax.process_index()])
+      delta_arr = global_from_local(self.mesh, local, self.axis)
+    return out + delta_arr
+
+  def set_cold_fetcher(self, fetcher) -> None:
+    """Register the remote cold-row resolver:
+    ``fetcher(partition: int, ids: np.int64 [M]) -> np [M, D]``."""
+    self._cold_fetcher = fetcher
+
+  def cold_get(self, partition: int, ids: np.ndarray) -> np.ndarray:
+    """Serve cold rows of a locally-held partition (the rpc-callee
+    counterpart of ``cold_fetcher``; reference RpcFeatureLookupCallee,
+    dist_feature.py:57-66)."""
+    rows = self._host_id2index[int(partition)][np.asarray(ids)]
+    return self._host_cold[int(partition)][
+        rows - int(self.hot_counts[int(partition)])]
 
   # -- builders ----------------------------------------------------------
 
@@ -135,9 +273,13 @@ class DistFeature:
   @classmethod
   def from_dist_datasets(cls, mesh: Mesh, datasets, ntype=None,
                          axis: str = 'data', dtype=None,
-                         kind: str = 'node', row_gather=None):
-    """Single-host simulation: build from every partition's DistDataset
-    (features must be fully device-resident).
+                         kind: str = 'node', row_gather=None,
+                         cold_fetcher=None, split_ratio=None):
+    """Single-host simulation: build from every partition's DistDataset.
+    Each partition Feature's own hot/cold split carries over: its cold
+    rows become this store's host shard for that partition (beyond-HBM
+    distributed features, reference unified_tensor.cu:202-231).
+    ``split_ratio`` overrides the per-Feature split when given.
 
     ``kind='edge'`` builds the *edge*-feature store (id space = global
     edge ids, routed by the edge-feature partition book) — the TPU
@@ -146,7 +288,7 @@ class DistFeature:
     selects the edge type for hetero datasets.
     """
     assert kind in ('node', 'edge')
-    parts, pbs = [], []
+    parts, pbs, hots = [], [], []
     num_ids = 0
     for ds in datasets:
       if kind == 'edge':
@@ -160,20 +302,39 @@ class DistFeature:
       feat.lazy_init()
       pbs.append(pb)
       num_ids = max(num_ids, pb.table.shape[0])
-      parts.append((np.asarray(feat.device_part), feat._id2index))
+      if feat.fully_device_resident:
+        block = np.asarray(feat.device_part)
+      else:  # reassemble [hot | cold] on host; __init__ re-splits.
+        # _cold keeps the SOURCE dtype — cast it so a compression cast
+        # (Feature(dtype=bf16)) survives instead of promoting the stack
+        block = np.concatenate(
+            [np.asarray(feat.device_part, dtype=feat.dtype),
+             np.asarray(feat._cold, dtype=feat.dtype)])
+      hots.append(feat.hot_count if split_ratio is None
+                  else int(round(block.shape[0] * float(split_ratio))))
+      parts.append((block, feat._id2index))
     return cls(mesh, parts, pbs, num_ids, axis=axis, dtype=dtype,
-               row_gather=row_gather)
+               row_gather=row_gather, hot_counts=hots,
+               cold_fetcher=cold_fetcher)
 
 
 def dist_feature_from_partitions_multihost(mesh, root_dir: str,
                                            ntype=None, axis: str = 'data',
                                            dtype=None,
-                                           kind: str = 'node'
+                                           kind: str = 'node',
+                                           row_gather=None,
+                                           split_ratio: float = 1.0,
+                                           cold_fetcher=None
                                            ) -> DistFeature:
   """Multi-host DistFeature: each process loads ONLY its partitions'
   feature blocks (cache-concat + PB rewrite included) and contributes
   them via process-local assembly; padding agreed with an allgather.
   Counterpart of dist_graph_from_partitions_multihost.
+
+  ``split_ratio < 1`` spills each partition's cold tail to its OWN
+  process's host RAM (beyond-HBM features); cross-process cold lookups
+  then need a ``cold_fetcher`` wired to the rpc fabric (see
+  DistFeature.set_cold_fetcher / cold_get).
 
   ``kind='edge'`` builds the edge-feature store from the partitions'
   efeat blocks + edge partition books (``ntype`` then selects the edge
@@ -216,6 +377,14 @@ def dist_feature_from_partitions_multihost(mesh, root_dir: str,
     feat_dim = feats.shape[1]
     local_max_rows = max(local_max_rows, feats.shape[0])
 
+  spill = float(split_ratio) < 1.0
+  # per-partition hot counts must be agreed globally (they are baked
+  # into every process's trace); partitions are disjoint so a summed
+  # allgather assembles the full [P] vector
+  local_hot = np.zeros(n_parts, np.int64)
+  for p in mine:
+    r = blocks[p][0].shape[0]
+    local_hot[p] = int(round(r * float(split_ratio))) if spill else r
   if jax.process_count() > 1:
     from jax.experimental import multihost_utils
     gathered = multihost_utils.process_allgather(
@@ -224,15 +393,34 @@ def dist_feature_from_partitions_multihost(mesh, root_dir: str,
     rows_max = int(arr[:, 0].max())
     num_ids = int(arr[:, 1].max())
     feat_dim = int(arr[:, 2].max())
+    hot_counts = np.asarray(
+        multihost_utils.process_allgather(jnp.asarray(local_hot))
+    ).sum(axis=0)
   else:
     rows_max = max(local_max_rows, 1)
+    hot_counts = local_hot
+  pad_rows = int(hot_counts.max()) if spill else rows_max
+  pad_rows = max(pad_rows, 1)
+
+  store = DistFeature.__new__(DistFeature)
+  store._finish_init(mesh, axis, num_ids, feat_dim, rows_max, n_parts,
+                     row_gather=row_gather, hot_counts=hot_counts,
+                     cold_fetcher=cold_fetcher, spill=spill)
 
   feats_l, maps_l, pbs_l = [], [], []
   for p in mine:
     feats, id2index, pb2 = blocks[p]
     if dtype is not None:
       feats = feats.astype(dtype)
-    pad = rows_max - feats.shape[0]
+    pb_dense = _pb_dense(pb2, num_ids)
+    if spill:
+      store._host_pb[p] = pb_dense
+      hot = int(hot_counts[p])
+      if hot < feats.shape[0]:
+        store._host_cold[p] = feats[hot:]
+        store._host_id2index[p] = np.asarray(id2index).astype(np.int32)
+      feats = feats[:hot]
+    pad = pad_rows - feats.shape[0]
     if pad:
       feats = np.concatenate(
           [feats, np.zeros((pad, feats.shape[1]), feats.dtype)])
@@ -242,15 +430,7 @@ def dist_feature_from_partitions_multihost(mesh, root_dir: str,
                                      np.int32)])
     feats_l.append(feats)
     maps_l.append(m[:num_ids])
-    pbs_l.append(_pb_dense(pb2, num_ids))
-
-  store = DistFeature.__new__(DistFeature)
-  store.mesh = mesh
-  store.axis = axis
-  store.num_ids = num_ids
-  store.feature_dim = feat_dim
-  store.rows_max = rows_max
-  store.num_partitions = n_parts
+    pbs_l.append(pb_dense)
 
   def stack_or_empty(parts, shape_tail, dtype_):
     if parts:
@@ -258,17 +438,10 @@ def dist_feature_from_partitions_multihost(mesh, root_dir: str,
     return np.zeros((0,) + shape_tail, dtype_)
 
   store.array = global_from_local(
-      mesh, stack_or_empty(feats_l, (rows_max, feat_dim), np.float32),
+      mesh, stack_or_empty(feats_l, (pad_rows, feat_dim), np.float32),
       axis)
   store.id2index = global_from_local(
       mesh, stack_or_empty(maps_l, (num_ids,), np.int32), axis)
   store.feat_pb = global_from_local(
       mesh, stack_or_empty(pbs_l, (num_ids,), np.int32), axis)
-  import jax as _jax
-  from jax.sharding import PartitionSpec as _P
-  store._lookup_fn = _jax.jit(_jax.shard_map(
-      lambda f, m, pb, i, v: store.lookup_local(f[0], m[0], pb[0], i, v),
-      mesh=mesh,
-      in_specs=(_P(axis), _P(axis), _P(axis), _P(axis), _P(axis)),
-      out_specs=_P(axis), check_vma=False))
   return store

@@ -60,10 +60,6 @@ class DistGraph:
                parts: Sequence[GraphPartitionData],
                node_pb: PartitionBook, edge_dir: str = 'out',
                axis: str = 'data'):
-    self.mesh = mesh
-    self.axis = axis
-    self.num_nodes = int(num_nodes)
-    self.edge_dir = edge_dir
     n_parts = len(parts)
     assert mesh.shape[axis] == n_parts, (
         f'mesh axis size {mesh.shape[axis]} != partitions {n_parts}')
@@ -74,7 +70,7 @@ class DistGraph:
     has_weights = all(p.weights is not None for p in parts)
     for g in parts:
       topo, local_of = _build_partition_block(
-          g, self.num_nodes, edge_dir, with_weights=has_weights)
+          g, int(num_nodes), edge_dir, with_weights=has_weights)
       built.append((topo, local_of))
       max_rows = max(max_rows, topo.num_rows)
       max_edges = max(max_edges, topo.num_edges)
@@ -100,11 +96,24 @@ class DistGraph:
                          if has_weights else None)
     self.local_row = jax.device_put(np.stack(locals_l), shard)  # [P, N]
     self.node_pb = jax.device_put(
-        _pb_dense(node_pb, self.num_nodes), repl)               # [N]
-    self.num_partitions = n_parts
-    self.max_rows = max_rows
-    self.max_edges = max_edges
-    self.max_degree = max_degree
+        _pb_dense(node_pb, int(num_nodes)), repl)               # [N]
+    self._finish_init(mesh, axis, num_nodes, edge_dir, n_parts,
+                      max_rows, max_edges, max_degree)
+
+  def _finish_init(self, mesh: Mesh, axis: str, num_nodes: int,
+                   edge_dir: str, n_parts: int, max_rows: int,
+                   max_edges: int, max_degree: int):
+    """Non-array state shared by __init__ and the multihost builder.
+    ANY new scalar/config field must be set here so alternate builders
+    can never miss it."""
+    self.mesh = mesh
+    self.axis = axis
+    self.num_nodes = int(num_nodes)
+    self.edge_dir = edge_dir
+    self.num_partitions = int(n_parts)
+    self.max_rows = int(max_rows)
+    self.max_edges = int(max_edges)
+    self.max_degree = int(max_degree)
 
   @classmethod
   def from_dataset_partitions(cls, mesh: Mesh, root_dir: str,
@@ -238,10 +247,8 @@ def dist_graph_from_partitions_multihost(mesh, root_dir: str,
     return np.zeros((0, width), dtype)
 
   store = DistGraph.__new__(DistGraph)
-  store.mesh = mesh
-  store.axis = axis
-  store.num_nodes = num_nodes
-  store.edge_dir = edge_dir
+  store._finish_init(mesh, axis, num_nodes, edge_dir, n_parts,
+                     max_rows, max_edges, max(int(gmax[2]), 1))
   store.indptr = global_from_local(
       mesh, stack_or_empty(ips, max_rows + 1, np.int32), axis)
   store.indices = global_from_local(
@@ -255,8 +262,4 @@ def dist_graph_from_partitions_multihost(mesh, root_dir: str,
       mesh, stack_or_empty(locals_l, num_nodes, np.int32), axis)
   store.node_pb = jax.device_put(
       _pb_dense(node_pb, num_nodes), NamedSharding(mesh, P()))
-  store.num_partitions = n_parts
-  store.max_rows = max_rows
-  store.max_edges = max_edges
-  store.max_degree = max(int(gmax[2]), 1)
   return store

@@ -104,10 +104,8 @@ class DistHeteroGraph:
              np.zeros(max_edges - topo.num_edges, np.float32)]))
     shard = NamedSharding(mesh, P(axis))
     repl = NamedSharding(mesh, P())
-    store.mesh = mesh
-    store.axis = axis
-    store.num_nodes = num_rows_global
-    store.edge_dir = 'out'
+    store._finish_init(mesh, axis, num_rows_global, 'out', n_parts,
+                       max_rows, max_edges, max_degree)
     store.indptr = jax.device_put(np.stack(indptrs), shard)
     store.indices = jax.device_put(np.stack(indices_l), shard)
     store.edge_ids = jax.device_put(np.stack(eids_l), shard)
@@ -116,10 +114,6 @@ class DistHeteroGraph:
     store.local_row = jax.device_put(np.stack(locals_l), shard)
     store.node_pb = jax.device_put(_pb_dense(node_pb, num_rows_global),
                                    repl)
-    store.num_partitions = n_parts
-    store.max_rows = max_rows
-    store.max_edges = max_edges
-    store.max_degree = max_degree
 
   @classmethod
   def from_dataset_partitions(cls, mesh: Mesh, root_dir: str,
@@ -413,6 +407,12 @@ class DistHeteroTrainStep:
     weighted per-etype collective one-hop (reference
     neighbor_sampler.py:96-144 hetero weighted loops)."""
     import optax
+    from ..parallel.dist_feature import require_device_resident
+    for t, st in features.items():
+      require_device_resident(st, f'DistHeteroTrainStep features[{t!r}]')
+    for e, st in (edge_features or {}).items():
+      require_device_resident(
+          st, f'DistHeteroTrainStep edge_features[{e!r}]')
     self.g = graph
     self.features = features
     self.edge_features = edge_features or {}

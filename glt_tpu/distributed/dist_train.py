@@ -46,6 +46,9 @@ class DistTrainStep:
                model, tx, labels, fanouts: Sequence[int],
                batch_size_per_device: int,
                edge_feature: Optional[DistFeature] = None):
+    from ..parallel.dist_feature import require_device_resident
+    require_device_resident(dist_feature, 'DistTrainStep features')
+    require_device_resident(edge_feature, 'DistTrainStep edge features')
     self.g = dist_graph
     self.f = dist_feature
     self.ef = edge_feature

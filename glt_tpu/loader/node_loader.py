@@ -110,17 +110,30 @@ class NodeLoader:
     rows = feat.map_ids(node)
     if feat.fully_device_resident:
       return feat.device_gather(rows)
-    # mixed residency: host round-trip for the cold side only
+    # mixed residency: hot rows stay on device end-to-end; only the cold
+    # slice crosses host->device (the UVA-read analogue). The previous
+    # design pulled the hot gather D2H and re-uploaded the whole batch —
+    # hot rows crossed PCIe twice, defeating the split.
     rows_np = as_numpy(rows).astype(np.int64)
-    hot_mask = rows_np < feat.hot_count
-    x = np.zeros((rows_np.shape[0], feat.feature_dim), dtype=feat.dtype)
-    if hot_mask.any():
-      x[hot_mask] = np.asarray(feat.device_gather(
-          jnp.asarray(rows_np[hot_mask])))
-    cold = ~hot_mask
-    if cold.any():
-      x[cold] = feat.gather_cold_host(rows_np[cold])
-    return jax.device_put(x)
+    rows_dev = jnp.asarray(rows_np)
+    hot = jnp.where(rows_dev < feat.hot_count, rows_dev, 0)
+    x = feat.device_gather(hot)                  # [B, D], cold lanes junk
+    cold_idx = np.nonzero(rows_np >= feat.hot_count)[0]
+    if cold_idx.size:
+      cold_vals = feat.gather_cold_host(rows_np[cold_idx]) \
+          .astype(feat.dtype)
+      # pad to the next power of two (duplicating the first cold lane)
+      # so the eager scatter compiles O(log B) shapes, not one per batch
+      cap = 1 << (int(cold_idx.size - 1)).bit_length()
+      pad = cap - cold_idx.size
+      if pad:
+        cold_idx = np.concatenate(
+            [cold_idx, np.full(pad, cold_idx[0], cold_idx.dtype)])
+        cold_vals = np.concatenate(
+            [cold_vals, np.broadcast_to(cold_vals[0], (pad,) +
+                                        cold_vals.shape[1:])])
+      x = x.at[jnp.asarray(cold_idx)].set(jax.device_put(cold_vals))
+    return x
 
   def _collate_homo(self, out: SamplerOutput, seeds, n_valid) -> Batch:
     x = None

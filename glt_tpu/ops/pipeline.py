@@ -63,9 +63,24 @@ def make_dedup_tables(num_nodes: int):
   from .unique import dense_make_tables
   if dedup_engine() == 'sort':
     # two distinct buffers: callers donate both, and donating one buffer
-    # twice is an XLA execute error
+    # twice is an XLA execute error. Shape (1,) doubles as the engine
+    # tag _check_engine_tables verifies at trace time (dense tables are
+    # always [num_nodes + 1] >= 2).
     return jnp.zeros((1,), jnp.int32), jnp.zeros((1,), jnp.int32)
   return dense_make_tables(num_nodes)
+
+
+def _check_engine_tables(table) -> None:
+  """Trace-time guard for the alloc-time/trace-time engine contract:
+  running the dense path against the sort engine's 1-element placeholder
+  tables would produce silently wrong samples (every dense_assign
+  collides on slot 0). Raising here turns an env flip between
+  make_dedup_tables and the jitted trace into a loud error."""
+  if dedup_engine() == 'table' and table.shape[0] < 2:
+    raise ValueError(
+        "dedup tables were allocated for the 'sort' engine (placeholder "
+        "shape (1,)) but GLT_DEDUP/backend now selects 'table'; "
+        "re-allocate with make_dedup_tables under the active engine")
 
 
 def sample_budget(batch_size: int, fanouts: Sequence[int]) -> int:
@@ -99,11 +114,19 @@ def multihop_sample(one_hop: OneHopFn,
 
   ``one_hop(frontier_ids, fanout, key, mask)`` performs one sampling hop.
   Tables are returned reset, ready for the next batch.
+
+  Result contract (both engines, homo and hetero): lanes where
+  ``edge_mask`` is False carry -1 in the child-label buffer (``row``
+  here; ``col`` holds parent labels which are always valid), and invalid
+  seed slots carry -1 in ``seed_labels`` — consumers that ignore
+  edge_mask still see one well-defined value per engine
+  (tests/test_sorted_inducer.py pins this).
   """
   if dedup_engine() == 'sort':
     out = _multihop_sample_sorted(one_hop, seeds, n_valid, fanouts, key,
                                   with_edge=with_edge)
     return out, table, scratch
+  _check_engine_tables(table)
   batch_size = seeds.shape[0]
   budget = sample_budget(batch_size, fanouts)
   state = dense_init(table, scratch, budget)
@@ -277,6 +300,8 @@ def multihop_sample_hetero(one_hops, trav, num_neighbors, num_hops,
         one_hops, trav, num_neighbors, num_hops, caps, budgets, seeds,
         n_valid, key, with_edge=with_edge)
     return result, tables
+  for t in tables:
+    _check_engine_tables(tables[t][0])
   types = list(budgets)
   states = {t: dense_init(tables[t][0], tables[t][1], budgets[t])
             for t in types}

@@ -26,6 +26,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..utils import as_numpy
 
 
+def require_device_resident(store, ctx: str) -> None:
+  """Fused SPMD train steps gather features with ``lookup_local`` inside
+  one jitted program, where the host-spill phase can never run — a
+  spilled store there would silently train on zero vectors for every
+  cold row. Trainers call this up front to fail loudly instead."""
+  if store is not None and getattr(store, '_spill', False):
+    raise NotImplementedError(
+        f'{ctx}: this train step runs sampling+gather+update as one '
+        'jitted SPMD program and cannot resolve host-spilled (cold) '
+        'feature rows; use a device-resident store (split_ratio=1.0) '
+        'or the loader-driven path (DistLoader / NodeLoader collate, '
+        'which resolves cold rows on host between device calls)')
+
+
 class ShardedFeature:
   """[N, D] feature table row-sharded over one mesh axis.
 
@@ -35,7 +49,7 @@ class ShardedFeature:
   """
 
   def __init__(self, feats, mesh: Mesh, axis: str = 'data', dtype=None,
-               row_gather=None):
+               row_gather=None, split_ratio: float = 1.0):
     # row_gather: optional (shard [R, D], rows [M]) -> [M, D] override
     # for the serving gather — tests inject the interpret-mode Pallas
     # kernel; on TPU GLT_USE_PALLAS=1 selects it automatically
@@ -54,8 +68,30 @@ class ShardedFeature:
     if dtype is not None:
       feats = feats.astype(dtype)
     self.feature_dim = feats.shape[1]
+    # host spill (reference unified_tensor.cu:202-231 pinned-CPU shard):
+    # rows [hot_count, rows_per_shard) of EVERY shard stay host-side;
+    # the uniform per-shard split keeps hot-ness arithmetic, so the
+    # requester resolves cold lanes without any device flag. Cold
+    # blocks are numpy views of ``feats`` — no extra host copy.
+    self.split_ratio = float(split_ratio)
+    self.hot_count = (self.rows_per_shard if self.split_ratio >= 1.0
+                      else max(1, int(round(self.rows_per_shard
+                                            * self.split_ratio))))
+    self._spill = self.hot_count < self.rows_per_shard
+    if self._spill:
+      self._host_cold = [
+          feats[p * self.rows_per_shard + self.hot_count:
+                (p + 1) * self.rows_per_shard]
+          for p in range(n_shards)]
+      hot = np.concatenate([
+          feats[p * self.rows_per_shard:
+                p * self.rows_per_shard + self.hot_count]
+          for p in range(n_shards)])
+    else:
+      self._host_cold = None
+      hot = feats
     self.array = jax.device_put(
-        feats, NamedSharding(mesh, P(axis)))
+        hot, NamedSharding(mesh, P(axis)))
     # compiled once; rebuilding shard_map per call would re-trace
     self._lookup_fn = jax.jit(jax.shard_map(
         lambda shard, i, v: self.lookup_local(shard, i, v),
@@ -103,12 +139,13 @@ class ShardedFeature:
     req_in = jax.lax.all_to_all(req, ax, split_axis=0, concat_axis=0,
                                 tiled=False)
     req_in = req_in.reshape(n_shards, b)
-    # serve from the local block
+    # serve from the local block (hot rows only when spilling; cold
+    # lanes return zero and the host phase in lookup() fills them)
     my_index = jax.lax.axis_index(ax)
     local_rows = req_in - my_index * self.rows_per_shard
-    ok = (local_rows >= 0) & (local_rows < self.rows_per_shard) & \
+    ok = (local_rows >= 0) & (local_rows < self.hot_count) & \
         (req_in >= 0)
-    safe_rows = jnp.clip(local_rows, 0, self.rows_per_shard - 1)
+    safe_rows = jnp.clip(local_rows, 0, self.hot_count - 1)
     # one DMA descriptor per served row instead of XLA's
     # per-output-element gather (the UnifiedTensor GatherTensorKernel
     # analogue, done the TPU way), when enabled
@@ -134,9 +171,31 @@ class ShardedFeature:
   def lookup(self, ids, valid=None) -> jax.Array:
     """Whole-mesh lookup from the host side: ids [n_shards * B] laid out
     shard-major; returns globally-sharded [n_shards * B, D]."""
-    ids = jnp.asarray(as_numpy(ids))
+    ids_np = as_numpy(ids).astype(np.int64)
+    ids = jnp.asarray(ids_np)
     if valid is None:
       valid = jnp.ones(ids.shape, bool)
     n_shards = self.mesh.shape[self.axis]
     assert ids.shape[0] % n_shards == 0
-    return self._lookup_fn(self.array, ids, valid)
+    out = self._lookup_fn(self.array, ids, valid)
+    if not self._spill:
+      return out
+    # host phase: cold-ness is arithmetic under the range rule, so the
+    # requester finds its cold lanes without any device round-trip and
+    # merges them as one sharded add (cold lanes are zero in ``out``)
+    valid_np = as_numpy(valid).astype(bool)
+    owner = np.clip(ids_np // self.rows_per_shard, 0, n_shards - 1)
+    local_row = ids_np - owner * self.rows_per_shard
+    cold = valid_np & (local_row >= self.hot_count) & \
+        (ids_np >= 0) & (ids_np < self.num_rows)
+    if not cold.any():
+      return out
+    lanes = np.nonzero(cold)[0]
+    np_dtype = np.dtype(out.dtype)
+    delta = np.zeros((ids_np.shape[0], self.feature_dim), np_dtype)
+    for p in np.unique(owner[lanes]):
+      m = lanes[owner[lanes] == p]
+      delta[m] = self._host_cold[int(p)][
+          local_row[m] - self.hot_count].astype(np_dtype)
+    delta_arr = jax.device_put(delta, out.sharding)
+    return out + delta_arr

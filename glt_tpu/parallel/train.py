@@ -44,6 +44,8 @@ class SPMDSageTrainStep:
   def __init__(self, mesh: Mesh, model, tx, graph: Graph, feature,
                labels, fanouts: Sequence[int],
                batch_size_per_device: int, axis: str = 'data'):
+    from .dist_feature import require_device_resident
+    require_device_resident(feature, 'SPMDSageTrainStep')
     self.mesh = mesh
     self.model = model
     self.tx = tx

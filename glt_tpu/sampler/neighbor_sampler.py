@@ -178,8 +178,11 @@ class NeighborSampler(BaseSampler):
       if not use_pallas_default():
         return {}
       fn = gather_windows
+    sources = g.window_arrays(width, fields)
+    if any(sources.get(f) is None for f in fields):
+      return {}  # HOST-mode (or missing) edge arrays: XLA fallback
     return dict(window_gather=lambda arr, st, w: fn(arr, st, width=w),
-                window_sources=g.window_arrays(width, fields))
+                window_sources=sources)
 
   def _one_hop(self, g: Graph, frontier, fanout, key, mask):
     """Dispatch full/uniform/weighted one-hop sampling on graph ``g``."""

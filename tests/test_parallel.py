@@ -166,3 +166,50 @@ def test_sharded_feature_pallas_row_gather_parity(mesh):
                                                      interpret=True))
   np.testing.assert_array_equal(np.asarray(base.lookup(ids)),
                                 np.asarray(fast.lookup(ids)))
+
+
+def test_sharded_feature_spill_parity(mesh):
+  # host-spill store must be value-identical to the fully-resident one
+  n, d = 100, 8
+  feats = np.random.default_rng(7).normal(size=(n, d)).astype(np.float32)
+  base = ShardedFeature(feats, mesh)
+  spill = ShardedFeature(feats, mesh, split_ratio=0.3)
+  assert spill._spill and spill.hot_count < spill.rows_per_shard
+  rng = np.random.default_rng(8)
+  ids = rng.integers(0, n, size=8 * 16)
+  valid = rng.random(8 * 16) < 0.8
+  a = np.asarray(base.lookup(ids, jnp.asarray(valid)))
+  b = np.asarray(spill.lookup(ids, jnp.asarray(valid)))
+  np.testing.assert_allclose(a, b)
+  np.testing.assert_allclose(b[valid], feats[ids[valid]])
+  np.testing.assert_allclose(b[~valid], 0.0)
+
+
+def test_sharded_feature_spill_all_cold(mesh):
+  # split_ratio ~ 0: everything except the forced 1-row hot floor is
+  # host-resident; values must still be exact
+  n, d = 64, 4
+  feats = np.arange(n * d, dtype=np.float32).reshape(n, d)
+  spill = ShardedFeature(feats, mesh, split_ratio=0.0)
+  assert spill.hot_count == 1
+  ids = np.arange(64)
+  out = np.asarray(spill.lookup(ids))
+  np.testing.assert_allclose(out, feats[ids])
+
+
+def test_spill_store_rejected_by_fused_train_step(mesh):
+  # the fused SPMD step cannot resolve host-spilled rows in-jit; it
+  # must fail loudly at construction, not train on zero vectors
+  n = 40
+  rows, cols, _ = ring_edges(n)
+  from glt_tpu.data import Dataset
+  ds = Dataset(edge_dir='out')
+  ds.init_graph(edge_index=np.stack([rows, cols]), num_nodes=n)
+  sf = ShardedFeature(np.eye(n, dtype=np.float32), mesh,
+                      split_ratio=0.5)
+  import optax
+  model = GraphSAGE(hidden_features=8, out_features=4, num_layers=1)
+  with pytest.raises(NotImplementedError, match='host-spilled'):
+    SPMDSageTrainStep(mesh, model, optax.sgd(1e-2), ds.get_graph(), sf,
+                      (np.arange(n) % 4).astype(np.int32), fanouts=[2],
+                      batch_size_per_device=4)
