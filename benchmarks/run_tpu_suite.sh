@@ -30,11 +30,9 @@ run_bench() {  # bench.py steps: self-supervising (probe child + budget),
   tail -c 200 "$OUT/$name.json" >&2; echo >&2
 }
 
-# 0. prime the persistent compile cache (first compile is the slow one;
-# bench.py and the driver's end-of-round run share .jax_cache)
-run_bench cache_prime python bench.py
-
-# 1. headline engine/scan/PRNG A/Bs (bench.py is supervised + retried)
+# 1. headline engine/scan/PRNG A/Bs (bench.py is supervised + retried).
+# The first successful step doubles as the compile-cache prime: bench.py
+# writes .jax_cache, which the driver's end-of-round run reuses.
 run_bench bench_sort_scan4 python bench.py
 run_bench bench_table_scan4 env GLT_DEDUP=table python bench.py
 run_bench bench_sort_scan1 env GLT_BENCH_SCAN=1 python bench.py

@@ -14,9 +14,19 @@ program runs over TPU chips with per-host partition loading. At
 """
 import argparse
 import os
+import resource
 import sys
 import tempfile
 import time
+
+
+def peak_rss_gb() -> float:
+  """Linux ru_maxrss is KiB; the high-water mark of this process."""
+  return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+
+
+def log_rss(stage: str) -> None:
+  print(f'[rss] {stage}: peak {peak_rss_gb():.2f} GB', flush=True)
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), '..'))
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), '..', '..'))
@@ -112,6 +122,7 @@ def main():
     compress(root, layout='CSC', bf16=args.bf16, topology=False)
     split_seeds(root)
   counts, edges, feats, labels, train_idx, val_idx = load_igbh_root(root)
+  log_rss('data loaded')
   num_classes = int(labels.max()) + 1
   total_edges = sum(e.shape[1] for e in edges.values())
   mll.event('global_batch_size',
@@ -139,6 +150,8 @@ def main():
   RandomPartitioner(part_root, num_parts=args.num_devices,
                     num_nodes=dict(counts), edge_index=edges,
                     node_feat=part_feats).partition()
+  del part_feats
+  log_rss('partitioned')
 
   mesh = make_mesh(args.num_devices)
   dg = DistHeteroGraph.from_dataset_partitions(mesh, part_root)
@@ -160,6 +173,7 @@ def main():
       batch_size_per_device=args.batch_size, seed_type='paper', seed=0)
   params = step.init_params(jax.random.key(0))
   opt = tx.init(params)
+  log_rss('stores built + step compiled-ready')
 
   start_step = 0
   if args.ckpt_dir and args.resume:
@@ -223,6 +237,7 @@ def main():
     mll.eval_accuracy(acc, epoch)
     mll.epoch_stop(epoch)
     print(f'epoch {epoch}: val_acc={acc:.4f} ({correct}/{total})')
+    log_rss(f'epoch {epoch} done')
 
   if args.ckpt_dir:
     save_checkpoint(args.ckpt_dir, global_step, params, opt_state=opt)

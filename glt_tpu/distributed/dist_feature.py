@@ -19,8 +19,20 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.collectives import all_to_all, bucket_by_owner, unbucket
+from ..parallel.dist_feature import _more_rounds_global, overflow_lanes
 from ..utils import as_numpy
 from .dist_graph import _pb_dense
+
+
+def _flag_lanes(flag) -> np.ndarray:
+  """Global lane indices where a sharded bool array is True, collected
+  from this process's addressable shards."""
+  lanes = []
+  for s in flag.addressable_shards:
+    nz = np.nonzero(np.asarray(s.data))[0]
+    if nz.size:
+      lanes.append((s.index[0].start or 0) + nz)
+  return (np.concatenate(lanes) if lanes else np.zeros(0, np.int64))
 
 
 class DistFeature:
@@ -42,7 +54,7 @@ class DistFeature:
                num_ids: int, axis: str = 'data', dtype=None,
                row_gather=None, split_ratio: float = 1.0,
                hot_counts: Optional[Sequence[int]] = None,
-               cold_fetcher=None):
+               cold_fetcher=None, bucket_cap: int = 0):
     n_parts = len(parts)
     assert mesh.shape[axis] == n_parts
     rows_max = max(max(f.shape[0] for f, _ in parts), 1)
@@ -53,7 +65,7 @@ class DistFeature:
     self._finish_init(mesh, axis, num_ids, parts[0][0].shape[1],
                       rows_max, n_parts, row_gather=row_gather,
                       hot_counts=hot_counts, cold_fetcher=cold_fetcher,
-                      spill=spill)
+                      spill=spill, bucket_cap=bucket_cap)
     if not isinstance(feat_pb, (list, tuple)):
       feat_pb = [feat_pb] * n_parts
     feats_l, maps_l, pbs_l = [], [], []
@@ -64,6 +76,8 @@ class DistFeature:
       hot = self.hot_counts[p]
       pb_dense = _pb_dense(feat_pb[p], self.num_ids)
       pbs_l.append(pb_dense)
+      if self.bucket_cap:
+        self._host_pb[p] = pb_dense
       if self._spill:
         # every local partition keeps its host routing book: a
         # fully-resident requester can still route a lane to a spilled
@@ -91,7 +105,7 @@ class DistFeature:
   def _finish_init(self, mesh: Mesh, axis: str, num_ids: int,
                    feat_dim: int, rows_max: int, n_parts: int,
                    row_gather=None, hot_counts=None, cold_fetcher=None,
-                   spill=None):
+                   spill=None, bucket_cap: int = 0):
     """Non-array state shared by __init__ and every alternate builder.
     ANY new scalar/config field must be set here, so a builder that
     assembles the arrays differently (e.g. the multihost
@@ -121,6 +135,11 @@ class DistFeature:
     self._host_id2index = {}  # part -> np [N] (local partitions only)
     self._host_pb = {}        # part -> np [N] requester routing book
     self._cold_fetcher = cold_fetcher
+    # bucket_cap < B caps each per-peer request bucket (see
+    # parallel.ShardedFeature.bucket_cap); the drain loop in lookup()
+    # replays the routing with _host_pb, which __init__ retains
+    # whenever bucket_cap is set
+    self.bucket_cap = int(bucket_cap)
     self._hot_counts_dev = jnp.asarray(self.hot_counts)
     # compiled once; rebuilding shard_map per call would re-trace
     self._lookup_fn = jax.jit(jax.shard_map(
@@ -145,8 +164,10 @@ class DistFeature:
     n = self.num_partitions
     owner = jnp.take(pb, jnp.clip(ids, 0, self.num_ids - 1), mode='clip')
     owner = jnp.where(valid, owner, n)
-    req, meta = bucket_by_owner(ids, owner, n)
-    req_in = all_to_all(req, ax)                      # [P, B]
+    cap = (self.bucket_cap if 0 < self.bucket_cap < ids.shape[0]
+           else 0)
+    req, meta = bucket_by_owner(ids, owner, n, capacity=cap)
+    req_in = all_to_all(req, ax)                      # [P, C]
     flat = req_in.reshape(-1)
     rows = jnp.take(map_shard, jnp.clip(flat, 0, self.num_ids - 1),
                     mode='clip')
@@ -176,37 +197,82 @@ class DistFeature:
     return full[:, :self.feature_dim], full[:, self.feature_dim] > 0
 
   def lookup(self, ids, valid=None) -> jax.Array:
-    """Whole-mesh lookup: ids [P * B] shard-major."""
+    """Whole-mesh lookup: ids [P * B] shard-major.
+
+    With ``bucket_cap`` set, requests a capped bucket could not carry
+    are drained through the SAME compiled program in follow-up rounds
+    (deterministic routing replayed on host with the retained books);
+    with host spill, flagged cold lanes are resolved from the host
+    shards at the end. Both compose: a lane that overflowed in round k
+    and turns out cold in round k+1 still resolves exactly once."""
     ids_np = as_numpy(ids).astype(np.int64)
     ids = jnp.asarray(ids_np, jnp.int32)
     if valid is None:
-      valid = jnp.ones(ids.shape, bool)
-    out = self._lookup_fn(self.array, self.id2index, self.feat_pb, ids,
-                          jnp.asarray(valid))
-    if not self._spill:
-      return out
-    out, flag = out
-    return self._resolve_cold(out, flag, ids_np)
+      valid_np = np.ones(ids_np.shape, bool)
+    else:
+      valid_np = as_numpy(valid).astype(bool)
+    n, b = self.num_partitions, ids_np.shape[0] // self.num_partitions
+    capped = 0 < self.bucket_cap < b
+    pending = valid_np
+    out = None
+    cold_lanes = []
+    while True:
+      res = self._lookup_fn(self.array, self.id2index, self.feat_pb,
+                            ids, jnp.asarray(pending))
+      if self._spill:
+        r, flag = res
+        cold_lanes.append(_flag_lanes(flag))
+      else:
+        r = res
+      out = r if out is None else out + r
+      if not capped:
+        break
+      over = self._overflow_replay(ids_np, pending, n, b)
+      if not _more_rounds_global(bool(over.any())):
+        break
+      pending = over
+    if self._spill:
+      lanes = np.concatenate(cold_lanes) if cold_lanes else \
+          np.zeros(0, np.int64)
+      if lanes.size:
+        out = self._resolve_cold(out, lanes, ids_np)
+    return out
+
+  def _overflow_replay(self, ids_np, pending, n, b) -> np.ndarray:
+    """Replay this round's routing for the lanes of partitions whose
+    books live in this process; OR across processes so every process
+    agrees on the next round's pending set."""
+    local = [i for i, dev in enumerate(self.mesh.devices.reshape(-1))
+             if dev.process_index == jax.process_index()]
+    missing = [d for d in local if d not in self._host_pb]
+    if missing:
+      raise RuntimeError(
+          f'bucket_cap drain needs the host routing books of local '
+          f'partitions {missing} but they were not retained — pass '
+          'bucket_cap to the constructor/builder (setting it after '
+          'construction would silently leave overflow lanes at zero)')
+    over = np.zeros(ids_np.shape[0], bool)
+    for d, book in self._host_pb.items():
+      sl = slice(d * b, (d + 1) * b)
+      owner_blk = np.where(
+          pending[sl],
+          book[np.clip(ids_np[sl], 0, self.num_ids - 1)], n)
+      over[sl] = overflow_lanes(owner_blk, n, b, self.bucket_cap)
+    if jax.process_count() > 1:
+      from jax.experimental import multihost_utils
+      over = np.asarray(multihost_utils.process_allgather(
+          jnp.asarray(over))).any(axis=0)
+    return over
 
   # -- host spill resolution ---------------------------------------------
 
-  def _resolve_cold(self, out, flag, ids_np) -> jax.Array:
+  def _resolve_cold(self, out, lanes, ids_np) -> jax.Array:
     """Serve the flagged lanes from the host shards and merge on device.
     Cold lanes are zero in ``out`` (the device phase masks them), so the
     merge is one sharded add — no SPMD-hostile scatter. Remote-process
     partitions resolve through ``cold_fetcher(part, ids) -> [M, D]``
     (e.g. an rpc callee); local ones read the in-process block."""
     b = ids_np.shape[0] // self.num_partitions
-    lanes = []
-    for s in flag.addressable_shards:
-      blk = np.asarray(s.data)
-      start = s.index[0].start or 0
-      nz = np.nonzero(blk)[0]
-      if nz.size:
-        lanes.append(start + nz)
-    if not lanes:
-      return out
-    lanes = np.concatenate(lanes)
     cold_ids = ids_np[lanes]
     dev_of = lanes // b
     owners = np.empty(lanes.shape[0], np.int64)
@@ -274,7 +340,8 @@ class DistFeature:
   def from_dist_datasets(cls, mesh: Mesh, datasets, ntype=None,
                          axis: str = 'data', dtype=None,
                          kind: str = 'node', row_gather=None,
-                         cold_fetcher=None, split_ratio=None):
+                         cold_fetcher=None, split_ratio=None,
+                         bucket_cap: int = 0):
     """Single-host simulation: build from every partition's DistDataset.
     Each partition Feature's own hot/cold split carries over: its cold
     rows become this store's host shard for that partition (beyond-HBM
@@ -315,7 +382,7 @@ class DistFeature:
       parts.append((block, feat._id2index))
     return cls(mesh, parts, pbs, num_ids, axis=axis, dtype=dtype,
                row_gather=row_gather, hot_counts=hots,
-               cold_fetcher=cold_fetcher)
+               cold_fetcher=cold_fetcher, bucket_cap=bucket_cap)
 
 
 def dist_feature_from_partitions_multihost(mesh, root_dir: str,
@@ -324,7 +391,8 @@ def dist_feature_from_partitions_multihost(mesh, root_dir: str,
                                            kind: str = 'node',
                                            row_gather=None,
                                            split_ratio: float = 1.0,
-                                           cold_fetcher=None
+                                           cold_fetcher=None,
+                                           bucket_cap: int = 0
                                            ) -> DistFeature:
   """Multi-host DistFeature: each process loads ONLY its partitions'
   feature blocks (cache-concat + PB rewrite included) and contributes
@@ -405,7 +473,8 @@ def dist_feature_from_partitions_multihost(mesh, root_dir: str,
   store = DistFeature.__new__(DistFeature)
   store._finish_init(mesh, axis, num_ids, feat_dim, rows_max, n_parts,
                      row_gather=row_gather, hot_counts=hot_counts,
-                     cold_fetcher=cold_fetcher, spill=spill)
+                     cold_fetcher=cold_fetcher, spill=spill,
+                     bucket_cap=bucket_cap)
 
   feats_l, maps_l, pbs_l = [], [], []
   for p in mine:
@@ -413,7 +482,7 @@ def dist_feature_from_partitions_multihost(mesh, root_dir: str,
     if dtype is not None:
       feats = feats.astype(dtype)
     pb_dense = _pb_dense(pb2, num_ids)
-    if spill:
+    if spill or bucket_cap:
       store._host_pb[p] = pb_dense
       hot = int(hot_counts[p])
       if hot < feats.shape[0]:

@@ -23,14 +23,23 @@ class BucketMeta(NamedTuple):
 
 
 def bucket_by_owner(ids: jax.Array, owner: jax.Array, n_shards: int,
-                    fill_value=-1):
-  """Pack ids into per-owner buckets [n_shards, B].
+                    fill_value=-1, capacity: int = 0):
+  """Pack ids into per-owner buckets [n_shards, C].
 
   ``owner`` must be in [0, n_shards) for valid entries and == n_shards
   for invalid/padded ones (they are dropped). Bucket slots beyond each
   owner's request count hold ``fill_value``.
+
+  ``capacity`` (default 0 = B, the worst case) caps each per-owner
+  bucket: a device then ships n_shards*C elements instead of
+  n_shards*B. Requests ranked past the cap are NOT packed — they come
+  back as ``invalid_value`` from :func:`unbucket`, and the caller
+  re-issues them (the bucketing is deterministic, so the host can
+  replay it and drain overflow through the same compiled program; see
+  ShardedFeature.lookup).
   """
   b = ids.shape[0]
+  cap = capacity if capacity and capacity < b else b
   order = jnp.argsort(owner, stable=True)
   owner_sorted = jnp.take(owner, order)
   counts = jnp.bincount(jnp.minimum(owner_sorted, n_shards),
@@ -39,17 +48,19 @@ def bucket_by_owner(ids: jax.Array, owner: jax.Array, n_shards: int,
   pos = jnp.arange(b) - jnp.take(
       offsets, jnp.minimum(owner_sorted, n_shards - 1))
   meta = BucketMeta(order, owner_sorted, pos)
-  return bucket_payload(ids, meta, n_shards, fill_value), meta
+  return bucket_payload(ids, meta, n_shards, fill_value,
+                        capacity=cap), meta
 
 
 def unbucket(resp: jax.Array, meta: BucketMeta, n_shards: int,
              invalid_value=0) -> jax.Array:
-  """Invert bucket_by_owner over a response [n_shards, B, ...]: returns
-  [B, ...] in the original request order; dropped slots get
-  ``invalid_value``."""
-  ok = meta.owner_sorted < n_shards
+  """Invert bucket_by_owner over a response [n_shards, C, ...]: returns
+  [B, ...] in the original request order; dropped and over-capacity
+  slots get ``invalid_value``."""
+  cap = resp.shape[1]
+  ok = (meta.owner_sorted < n_shards) & (meta.pos_in_bucket < cap)
   gathered = resp[jnp.minimum(meta.owner_sorted, n_shards - 1),
-                  meta.pos_in_bucket]
+                  jnp.minimum(meta.pos_in_bucket, cap - 1)]
   shape = (ok.shape[0],) + (1,) * (gathered.ndim - 1)
   gathered = jnp.where(ok.reshape(shape), gathered, invalid_value)
   out = jnp.zeros_like(gathered)
@@ -65,17 +76,18 @@ def all_to_all(x: jax.Array, axis_name: str) -> jax.Array:
 
 
 def bucket_payload(values: jax.Array, meta: BucketMeta, n_shards: int,
-                   fill_value=0) -> jax.Array:
+                   fill_value=0, capacity: int = 0) -> jax.Array:
   """Pack a companion payload with the SAME ordering as an existing
   bucket_by_owner call (e.g. the col of a (row, col) pair routed by the
   row's owner)."""
   b = values.shape[0]
+  cap = capacity if capacity and capacity < b else b
   vals_sorted = jnp.take(values, meta.order)
-  ok = meta.owner_sorted < n_shards
-  buckets = jnp.full((n_shards + 1, b), fill_value, values.dtype)
+  ok = (meta.owner_sorted < n_shards) & (meta.pos_in_bucket < cap)
+  buckets = jnp.full((n_shards + 1, cap), fill_value, values.dtype)
   buckets = buckets.at[
       jnp.where(ok, meta.owner_sorted, n_shards),
-      jnp.where(ok, meta.pos_in_bucket, 0)].set(
+      jnp.where(ok, jnp.minimum(meta.pos_in_bucket, cap - 1), 0)].set(
           jnp.where(ok, vals_sorted, fill_value))
   return buckets[:n_shards]
 

@@ -26,18 +26,58 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..utils import as_numpy
 
 
+def _more_rounds_global(more: bool) -> bool:
+  """Agree the drain-loop continuation across processes (every process
+  must issue the same number of collective rounds)."""
+  if jax.process_count() == 1:
+    return more
+  from jax.experimental import multihost_utils
+  return bool(np.asarray(multihost_utils.process_allgather(
+      jnp.asarray([1 if more else 0]))).max())
+
+
+def overflow_lanes(owner_key: np.ndarray, n_shards: int, b: int,
+                   cap: int) -> np.ndarray:
+  """Host replay of the device bucketing: True where a valid request
+  (owner_key < n_shards) ranks past its per-owner bucket capacity for
+  its B-lane device block. Must mirror the jnp stable-argsort bucketing
+  in lookup_local exactly."""
+  over = np.zeros(owner_key.shape[0], bool)
+  for lo in range(0, owner_key.shape[0], b):
+    ok = owner_key[lo:lo + b]
+    order = np.argsort(ok, kind='stable')
+    osort = ok[order]
+    counts = np.bincount(np.minimum(osort, n_shards),
+                         minlength=n_shards + 1)[:n_shards]
+    offsets = np.cumsum(counts) - counts
+    pos = np.arange(ok.shape[0]) - offsets[
+        np.minimum(osort, n_shards - 1)]
+    blk = np.zeros(ok.shape[0], bool)
+    blk[order] = (osort < n_shards) & (pos >= cap)
+    over[lo:lo + b] = blk
+  return over
+
+
 def require_device_resident(store, ctx: str) -> None:
   """Fused SPMD train steps gather features with ``lookup_local`` inside
   one jitted program, where the host-spill phase can never run — a
   spilled store there would silently train on zero vectors for every
   cold row. Trainers call this up front to fail loudly instead."""
-  if store is not None and getattr(store, '_spill', False):
+  if store is None:
+    return
+  if getattr(store, '_spill', False):
     raise NotImplementedError(
         f'{ctx}: this train step runs sampling+gather+update as one '
         'jitted SPMD program and cannot resolve host-spilled (cold) '
         'feature rows; use a device-resident store (split_ratio=1.0) '
         'or the loader-driven path (DistLoader / NodeLoader collate, '
         'which resolves cold rows on host between device calls)')
+  if getattr(store, 'bucket_cap', 0):
+    raise NotImplementedError(
+        f'{ctx}: bucket_cap relies on lookup()\'s host-side overflow '
+        'drain, which cannot run inside the fused jitted step — '
+        'overflowed lanes would silently train as zeros; use '
+        'bucket_cap=0 here (capped lookups are for the loader path)')
 
 
 class ShardedFeature:
@@ -49,7 +89,8 @@ class ShardedFeature:
   """
 
   def __init__(self, feats, mesh: Mesh, axis: str = 'data', dtype=None,
-               row_gather=None, split_ratio: float = 1.0):
+               row_gather=None, split_ratio: float = 1.0,
+               bucket_cap: int = 0):
     # row_gather: optional (shard [R, D], rows [M]) -> [M, D] override
     # for the serving gather — tests inject the interpret-mode Pallas
     # kernel; on TPU GLT_USE_PALLAS=1 selects it automatically
@@ -68,6 +109,13 @@ class ShardedFeature:
     if dtype is not None:
       feats = feats.astype(dtype)
     self.feature_dim = feats.shape[1]
+    # bucket_cap < B caps each per-peer request bucket: the two
+    # all_to_alls then move n_shards*C elements per device instead of
+    # the [P, B] worst case (VERDICT r2: P-times the necessary ICI
+    # bytes). Overflowed requests are drained by lookup() through the
+    # SAME compiled program — the bucketing is deterministic, so the
+    # host replays it to decide how many rounds are needed (usually 1).
+    self.bucket_cap = int(bucket_cap)
     # host spill (reference unified_tensor.cu:202-231 pinned-CPU shard):
     # rows [hot_count, rows_per_shard) of EVERY shard stay host-side;
     # the uniform per-shard split keeps hot-ness arithmetic, so the
@@ -128,17 +176,20 @@ class ShardedFeature:
     offsets = jnp.cumsum(counts) - counts
     pos_in_bucket = jnp.arange(b) - jnp.take(
         offsets, jnp.minimum(owner_sorted, n_shards - 1))
-    # fixed-capacity request buckets [n_shards, B]
+    # fixed-capacity request buckets [n_shards, C] (C = B by default)
+    cap = (self.bucket_cap if 0 < self.bucket_cap < b else b)
     sink_row, sink_col = n_shards, 0
-    brow = jnp.where(owner_sorted < n_shards, owner_sorted, sink_row)
-    req = jnp.full((n_shards + 1, b), -1, ids.dtype)
-    req = req.at[brow, jnp.where(owner_sorted < n_shards,
-                                 pos_in_bucket, sink_col)].set(ids_sorted)
+    keep = (owner_sorted < n_shards) & (pos_in_bucket < cap)
+    brow = jnp.where(keep, owner_sorted, sink_row)
+    req = jnp.full((n_shards + 1, cap), -1, ids.dtype)
+    req = req.at[brow, jnp.where(keep, pos_in_bucket,
+                                 sink_col)].set(
+        jnp.where(keep, ids_sorted, -1))
     req = req[:n_shards]
     # exchange requests: row p of the result = what peer p asked us for
     req_in = jax.lax.all_to_all(req, ax, split_axis=0, concat_axis=0,
                                 tiled=False)
-    req_in = req_in.reshape(n_shards, b)
+    req_in = req_in.reshape(n_shards, cap)
     # serve from the local block (hot rows only when spilling; cold
     # lanes return zero and the host phase in lookup() fills them)
     my_index = jax.lax.axis_index(ax)
@@ -160,10 +211,12 @@ class ShardedFeature:
     # send responses back; row p now holds our requests served by peer p
     resp = jax.lax.all_to_all(served, ax, split_axis=0, concat_axis=0,
                               tiled=False)
-    resp = resp.reshape(n_shards, b, self.feature_dim)
-    # positional stitch back to request order
-    gathered = resp[jnp.minimum(owner_sorted, n_shards - 1), pos_in_bucket]
-    gathered = jnp.where((owner_sorted < n_shards)[:, None], gathered, 0)
+    resp = resp.reshape(n_shards, cap, self.feature_dim)
+    # positional stitch back to request order (over-capacity lanes get
+    # zero; lookup() drains them in a follow-up round)
+    gathered = resp[jnp.minimum(owner_sorted, n_shards - 1),
+                    jnp.minimum(pos_in_bucket, cap - 1)]
+    gathered = jnp.where(keep[:, None], gathered, 0)
     out = jnp.zeros_like(gathered)
     out = out.at[order].set(gathered)
     return out
@@ -177,13 +230,47 @@ class ShardedFeature:
       valid = jnp.ones(ids.shape, bool)
     n_shards = self.mesh.shape[self.axis]
     assert ids.shape[0] % n_shards == 0
-    out = self._lookup_fn(self.array, ids, valid)
+    b = ids.shape[0] // n_shards
+    if 0 < self.bucket_cap < b:
+      out = self._lookup_capped(ids, ids_np,
+                                as_numpy(valid).astype(bool), n_shards,
+                                b)
+    else:
+      out = self._lookup_fn(self.array, ids, valid)
     if not self._spill:
       return out
-    # host phase: cold-ness is arithmetic under the range rule, so the
-    # requester finds its cold lanes without any device round-trip and
-    # merges them as one sharded add (cold lanes are zero in ``out``)
-    valid_np = as_numpy(valid).astype(bool)
+    return self._resolve_cold_sharded(out, ids_np,
+                                      as_numpy(valid).astype(bool),
+                                      n_shards)
+
+  def _lookup_capped(self, ids, ids_np, valid_np, n_shards, b):
+    """Drain overflowed requests through the SAME compiled lookup:
+    round k re-issues only the lanes the capped buckets could not carry
+    in round k-1. Served lanes are disjoint across rounds and unserved
+    lanes return zero, so the merge is a running add. Worst-case rounds
+    = ceil(B / C) (the all-ask-one-shard hot spot), where the total
+    bytes moved equal the old [P, B] single round — skew pays, the
+    common case doesn't."""
+    owner = np.where(
+        valid_np,
+        np.clip(ids_np // self.rows_per_shard, 0, n_shards - 1),
+        n_shards)
+    pending = valid_np
+    out = None
+    while True:
+      out_r = self._lookup_fn(self.array, ids, jnp.asarray(pending))
+      out = out_r if out is None else out + out_r
+      over = overflow_lanes(
+          np.where(pending, owner, n_shards), n_shards, b,
+          self.bucket_cap)
+      if not _more_rounds_global(bool(over.any())):
+        return out
+      pending = over
+
+  def _resolve_cold_sharded(self, out, ids_np, valid_np, n_shards):
+    """Host phase: cold-ness is arithmetic under the range rule, so the
+    requester finds its cold lanes without any device round-trip and
+    merges them as one sharded add (cold lanes are zero in ``out``)."""
     owner = np.clip(ids_np // self.rows_per_shard, 0, n_shards - 1)
     local_row = ids_np - owner * self.rows_per_shard
     cold = valid_np & (local_row >= self.hot_count) & \

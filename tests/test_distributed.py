@@ -886,3 +886,34 @@ def test_dist_feature_spill_cold_get_roundtrip(mesh, dist_datasets):
     np.testing.assert_allclose(vals[:, 0], cold_ids)
     served += cold_ids.size
   assert served > 0
+
+
+def test_dist_feature_bucket_cap_parity(mesh, dist_datasets):
+  # capped request buckets with drain rounds: value parity vs uncapped,
+  # including composition with host spill
+  rng = np.random.default_rng(9)
+  ids = rng.integers(0, N_NODES, N_PARTS * 16)
+  valid = rng.random(N_PARTS * 16) < 0.8
+  base = DistFeature.from_dist_datasets(mesh, dist_datasets)
+  want = np.asarray(base.lookup(ids, jnp.asarray(valid)))
+  # bucket_cap must go through the constructor/builder so the host
+  # routing books are retained for the drain replay
+  capped = DistFeature.from_dist_datasets(mesh, dist_datasets,
+                                          bucket_cap=4)  # B=16/device
+  got = np.asarray(capped.lookup(ids, jnp.asarray(valid)))
+  np.testing.assert_allclose(got, want)
+  spilled = DistFeature.from_dist_datasets(mesh, dist_datasets,
+                                           split_ratio=0.4,
+                                           bucket_cap=4)
+  got2 = np.asarray(spilled.lookup(ids, jnp.asarray(valid)))
+  np.testing.assert_allclose(got2, want)
+
+
+def test_dist_feature_bucket_cap_post_hoc_rejected(mesh, dist_datasets):
+  # setting bucket_cap after construction would silently zero overflow
+  # lanes; the drain must fail loudly instead
+  df = DistFeature.from_dist_datasets(mesh, dist_datasets)
+  df.bucket_cap = 4
+  ids = np.zeros(N_PARTS * 16, np.int64)  # hot-spot: forces overflow
+  with pytest.raises(RuntimeError, match='routing books'):
+    df.lookup(ids)
