@@ -304,12 +304,15 @@ class DistFeature:
     if jax.process_count() == 1:
       delta_arr = jax.device_put(delta, out.sharding)
     else:
-      from ..parallel.multihost import global_from_local
+      # flat [P*B, D] layout: supply this process's B-row blocks in
+      # device order (global_from_local is for [P, ...] stacks)
       local = np.concatenate(
           [delta[d * b:(d + 1) * b]
            for d, dev in enumerate(self.mesh.devices.reshape(-1))
            if dev.process_index == jax.process_index()])
-      delta_arr = global_from_local(self.mesh, local, self.axis)
+      delta_arr = jax.make_array_from_process_local_data(
+          NamedSharding(self.mesh, P(self.axis)), local,
+          global_shape=delta.shape)
     return out + delta_arr
 
   def set_cold_fetcher(self, fetcher) -> None:

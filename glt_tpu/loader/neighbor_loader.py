@@ -30,6 +30,7 @@ class NeighborLoader(NodeLoader):
                seed: Optional[int] = None,
                device=None,
                prefetch_depth: int = 0,
+               as_pyg_v1: bool = False,
                rng: Optional[np.random.Generator] = None):
     sampler = NeighborSampler(
         data.graph, num_neighbors,
@@ -39,3 +40,13 @@ class NeighborLoader(NodeLoader):
                      batch_size=batch_size, shuffle=shuffle,
                      drop_last=drop_last, collect_features=collect_features,
                      prefetch_depth=prefetch_depth, rng=rng)
+    #: yield PyG-v1 (batch_size, n_id, adjs) triples instead of Batch
+    #: (reference neighbor_loader.py:110 as_pyg_v1 mode)
+    self.as_pyg_v1 = bool(as_pyg_v1)
+
+  def __iter__(self):
+    it = super().__iter__()
+    if not self.as_pyg_v1:
+      return it
+    from .transform import to_pyg_v1
+    return (to_pyg_v1(b) for b in it)

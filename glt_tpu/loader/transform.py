@@ -10,7 +10,7 @@ when torch_geometric is importable (CPU interop only).
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict, NamedTuple, Optional
 
 import flax.struct
 import jax
@@ -132,13 +132,29 @@ def _freeze_offsets(offs):
   return {k: tuple(v) for k, v in offs.items()}
 
 
+class EdgeIndex(NamedTuple):
+  """Vendored PyG-v1 ``EdgeIndex`` adj (the reference re-exports
+  torch_geometric's, sampler/neighbor_sampler.py:32; vendoring the
+  3-field NamedTuple keeps the v1 training-loop idiom
+  ``for batch_size, n_id, adjs in loader: ... adj.edge_index ...``
+  working without a torch_geometric install)."""
+  edge_index: object   # [2, m] numpy, message-flow orientation
+  e_id: object         # [m] numpy global edge ids, or None
+  size: tuple          # (src_count, dst_count)
+
+  def to(self, device):  # PyG-v1 loops call adj.to(device); no-op here
+    return self
+
+
 def to_pyg_v1(batch: Batch):
   """PyG-v1-style (batch_size, n_id, adjs) view (the reference's
-  ``as_pyg_v1`` NeighborLoader mode, loader/neighbor_loader.py:110).
+  ``as_pyg_v1`` NeighborLoader mode, loader/neighbor_loader.py:110,
+  sampler/neighbor_sampler.py:448-472).
 
   adjs are returned outermost-hop-first (the order layer loops consume):
-  each is (edge_index [2, m] numpy in message-flow orientation, e_id or
-  None, size (src_count, dst_count)). Requires edge_hop_offsets.
+  each is an :class:`EdgeIndex` (edge_index [2, m] numpy in message-flow
+  orientation, e_id or None, size (src_count, dst_count)). Requires
+  edge_hop_offsets.
   """
   import numpy as np
   assert batch.edge_hop_offsets is not None
@@ -157,7 +173,7 @@ def to_pyg_v1(batch: Batch):
     e_id = eid[sl][keep] if eid is not None else None
     src_count = int(counts[:h + 2].sum())
     dst_count = int(counts[:h + 1].sum())
-    adjs.append((edge_index, e_id, (src_count, dst_count)))
+    adjs.append(EdgeIndex(edge_index, e_id, (src_count, dst_count)))
   return batch.batch_size, n_id, list(reversed(adjs))
 
 

@@ -62,6 +62,33 @@ def main():
   for shard in ex.addressable_shards:
     local = np.asarray(shard.data)
     np.testing.assert_allclose(local[:, 0], eids[shard.index[0]])
+
+  # beyond-HBM spill across PROCESSES: each process keeps its own
+  # partitions' cold rows in host RAM and serves the peer's cold
+  # lookups over the rpc fabric (reference RpcFeatureLookupCallee,
+  # dist_feature.py:57-66)
+  from glt_tpu.distributed.rpc import RpcClient, RpcServer
+  dfs = dist_feature_from_partitions_multihost(mesh, root,
+                                               split_ratio=0.5)
+  my_port, peer_port = int(sys.argv[4 + rank]), int(sys.argv[5 - rank])
+  server = RpcServer(port=my_port)
+  server.register('cold_get',
+                  lambda p, i: dfs.cold_get(int(p), np.asarray(i)))
+  server.start()
+  peer = RpcClient('127.0.0.1', peer_port, connect_retries=120,
+                   retry_interval=0.25)
+  dfs.set_cold_fetcher(
+      lambda p, i: np.asarray(peer.request('cold_get', int(p),
+                                           np.asarray(i))))
+  from jax.experimental import multihost_utils
+  multihost_utils.sync_global_devices('cold_rpc_up')
+  xs = dfs.lookup(jnp.asarray(ids))
+  for shard in xs.addressable_shards:
+    local = np.asarray(shard.data)
+    np.testing.assert_allclose(local[:, 0], ids[shard.index[0]])
+  multihost_utils.sync_global_devices('cold_rpc_done')
+  peer.close()
+  server.stop()
   print(f'RANK{rank}_OK', flush=True)
 
 

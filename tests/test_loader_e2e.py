@@ -198,3 +198,25 @@ def test_to_pyg_v1_adapter(ring):
     parent = n_id[edge_index[1]]
     for p, c in zip(parent, child):
       assert c in ((p + 1) % 40, (p + 2) % 40)
+
+
+def test_neighbor_loader_as_pyg_v1_mode(ring):
+  # the v1 training-loop idiom must work end to end without
+  # torch_geometric: for bs, n_id, adjs in loader, with attribute
+  # access on each adj (vendored EdgeIndex namedtuple)
+  loader = NeighborLoader(ring, [2, 2], input_nodes=np.arange(8),
+                          batch_size=8, as_pyg_v1=True, seed=0)
+  bs, n_id, adjs = next(iter(loader))
+  assert bs == 8
+  assert len(adjs) == 2
+  for adj in adjs:
+    a = adj.to('anywhere')           # PyG-v1 loops call .to(device)
+    assert a.edge_index.shape[0] == 2
+    src_count, dst_count = a.size
+    assert src_count >= dst_count
+    # message-flow: cols index the smaller (dst) frontier
+    if a.edge_index.shape[1]:
+      assert a.edge_index[1].max() < dst_count
+      assert a.edge_index[0].max() < src_count
+  # outermost hop first: first adj has the largest src frontier
+  assert adjs[0].size[0] >= adjs[1].size[0]

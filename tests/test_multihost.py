@@ -32,12 +32,14 @@ def test_two_process_distributed_sampling(tmp_path):
                     edge_index=np.stack([rows, cols]),
                     node_feat=feats, edge_feat=efeats).partition()
   port = _free_port()
+  rpc0, rpc1 = _free_port(), _free_port()
   worker = os.path.join(os.path.dirname(__file__), 'multihost_worker.py')
   env = dict(os.environ)
   env['PYTHONPATH'] = (os.path.dirname(os.path.dirname(worker))
                        + os.pathsep + env.get('PYTHONPATH', ''))
   procs = [subprocess.Popen(
-      [sys.executable, worker, str(r), str(tmp_path), str(port)],
+      [sys.executable, worker, str(r), str(tmp_path), str(port),
+       str(rpc0), str(rpc1)],
       stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
       text=True) for r in range(2)]
   outs = []
