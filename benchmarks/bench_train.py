@@ -51,7 +51,15 @@ def main():
   ap.add_argument('--epochs', type=int, default=1,
                   help='training epochs before the accuracy eval')
   ap.add_argument('--eval-batches', type=int, default=20)
+  ap.add_argument('--curve', action='store_true',
+                  help='eval after EVERY epoch (accuracy curve); with '
+                       '--plateau, stop once test_acc has not improved '
+                       'by >0.002 for that many epochs (convergence '
+                       'evidence, VERDICT r3 weak #5)')
+  ap.add_argument('--plateau', type=int, default=0)
   args = ap.parse_args()
+  if args.plateau and not args.curve:
+    args.curve = True  # plateau detection needs the per-epoch evals
 
   import jax
   if os.environ.get('GLT_BENCH_PLATFORM'):
@@ -132,8 +140,29 @@ def main():
   params, opt, loss = step(params, opt, b0)
   jax.block_until_ready(loss)
 
+  # built ONCE: per-epoch curve evals reuse the compiled sampler fns
+  eval_loader = NeighborLoader(ds, fanout, input_nodes=test_idx,
+                               batch_size=args.batch_size,
+                               shuffle=False, drop_last=False, seed=1)
+
+  def eval_acc(params):
+    correct = total = 0
+    for i, batch in enumerate(eval_loader):
+      if i >= args.eval_batches:
+        break
+      pred = np.asarray(predict(params, batch))
+      yb = np.asarray(batch.y)
+      nv = int((batch.metadata or {}).get('n_valid', yb.shape[0]))
+      correct += int((pred[:nv] == yb[:nv]).sum())
+      total += nv
+    return correct / max(total, 1), total
+
   dt = steps = edges = 0
-  for epoch in range(max(args.epochs, 1)):
+  curve = []
+  best, since_best = -1.0, 0
+  n_epochs = max(args.epochs, 1)
+  epoch = 0
+  while True:
     t0 = time.time()
     ep_steps = 0
     for batch in loader:
@@ -144,25 +173,35 @@ def main():
       if args.max_steps and ep_steps >= args.max_steps:
         break
     jax.block_until_ready(loss)
-    dt += time.time() - t0
-  per_epoch_steps = steps / max(args.epochs, 1)
-  full_epoch_est = (dt / max(args.epochs, 1)) * (
-      len(loader) / max(per_epoch_steps, 1))
-
-  # accuracy eval over held-out seeds through the same sampled pipeline
-  eval_loader = NeighborLoader(ds, fanout, input_nodes=test_idx,
-                               batch_size=args.batch_size, shuffle=False,
-                               drop_last=False, seed=1)
-  correct = total = 0
-  for i, batch in enumerate(eval_loader):
-    if i >= args.eval_batches:
+    ep_s = time.time() - t0   # training only; eval time excluded
+    dt += ep_s
+    epoch += 1
+    if args.curve:
+      acc, total = eval_acc(params)
+      curve.append(round(acc, 4))
+      print(json.dumps({'epoch': epoch, 'test_acc': round(acc, 4),
+                        'loss': round(float(loss), 4),
+                        'epoch_s': round(ep_s, 1)}),
+            file=_sys.stderr, flush=True)
+      if acc > best + 0.002:
+        best, since_best = acc, 0
+      else:
+        since_best += 1
+      if args.plateau and since_best >= args.plateau:
+        break
+    if epoch >= n_epochs and not (args.plateau and args.curve):
       break
-    pred = np.asarray(predict(params, batch))
-    yb = np.asarray(batch.y)
-    nv = int((batch.metadata or {}).get('n_valid', yb.shape[0]))
-    correct += int((pred[:nv] == yb[:nv]).sum())
-    total += nv
-  test_acc = correct / max(total, 1)
+    if args.plateau and args.curve and epoch >= max(n_epochs, 200):
+      break  # hard stop safety
+  n_epochs = epoch
+  per_epoch_steps = steps / n_epochs
+  full_epoch_est = (dt / n_epochs) * (len(loader) /
+                                      max(per_epoch_steps, 1))
+
+  if args.curve and curve:
+    test_acc = curve[-1]  # ``total`` keeps the last eval's seed count
+  else:
+    test_acc, total = eval_acc(params)
 
   dev = jax.devices()[0]
   print(json.dumps({
@@ -173,10 +212,14 @@ def main():
       'detail': {'steps_timed': steps, 'seconds': round(dt, 2),
                  'sampled_edges_per_sec': round(edges / max(dt, 1e-9), 1),
                  'final_loss': float(loss),
-                 'epochs': args.epochs,
+                 'epochs': n_epochs,
                  'test_acc': round(test_acc, 4),
+                 'acc_curve': curve if curve else None,
+                 'best_test_acc': round(max(curve), 4) if curve
+                 else round(test_acc, 4),
                  'linear_probe_acc': round(linear_probe_acc, 4),
                  'eval_seeds': total,
+                 'num_nodes': n,
                  'backend': dev.platform},
   }))
 

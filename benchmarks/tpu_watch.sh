@@ -14,15 +14,24 @@ OUT=benchmarks/tpu_runs
 mkdir -p "$OUT"
 
 commit_evidence() {
-  # Stage only non-empty .json evidence + logs; skip if nothing changed.
-  local staged=0
+  # Commit ONLY the non-empty evidence files, by explicit pathspec: a
+  # bare commit would sweep unrelated staged work, and a directory
+  # pathspec would commit working-tree state of every tracked file
+  # under $OUT — including a JSON a wedged suite step just truncated.
+  local files=()
   for f in "$OUT"/*.json; do
-    [ -s "$f" ] && git add "$f" && staged=1
+    [ -s "$f" ] && files+=("$f")
   done
-  git add "$OUT"/*.log 2>/dev/null || true
-  if ! git diff --cached --quiet; then
-    git commit -q -m "TPU evidence: auto-commit from tpu_watch ($(date -Is))" \
-      || true
+  for f in "$OUT"/*.log; do
+    [ -s "$f" ] && files+=("$f")
+  done
+  [ "${#files[@]}" -eq 0 ] && return 0
+  # stage first: suite outputs are usually UNTRACKED, and a commit
+  # pathspec only matches files git already knows about
+  git add -- "${files[@]}" 2>/dev/null || true
+  if git commit -q \
+      -m "TPU evidence: auto-commit from tpu_watch ($(date -Is))" \
+      -- "${files[@]}" 2>/dev/null; then
     echo "$(date -Is) evidence committed" >> "$OUT/watch.log"
   fi
 }

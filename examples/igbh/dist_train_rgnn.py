@@ -32,8 +32,11 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), '..'
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), '..', '..'))
 
 
-def load_igbh_root(root: str):
-  """Load the compress_graph/split_seeds output tree."""
+def load_igbh_root(root: str, load_feats: bool = True):
+  """Load the compress_graph/split_seeds output tree. ``load_feats=
+  False`` skips the full feature matrices (multihost mode builds the
+  stores from the per-rank partition blocks instead — loading the whole
+  table on every rank would defeat per-rank memory discipline)."""
   import numpy as np
   from compress_graph import load_meta
   proc = os.path.join(root, 'processed')
@@ -45,7 +48,7 @@ def load_igbh_root(root: str):
       s, r, d = name.split('__')
       edges[(s, r, d)] = np.load(p)
   feats = {}
-  for t in counts:
+  for t in counts if load_feats else ():
     bf = next((p for p in (os.path.join(root, lay, t,
                                         'node_feat_bf16.npy')
                            for lay in ('csc', 'csr'))
@@ -85,20 +88,42 @@ def main():
   ap.add_argument('--cpu-mesh', action=argparse.BooleanOptionalAction,
                   default=True,
                   help='--no-cpu-mesh runs on the real device mesh')
+  ap.add_argument('--part-root', default=None,
+                  help='partition dir; reused if it already holds META '
+                       '(required pre-built in --coordinator mode)')
+  ap.add_argument('--coordinator', default=None,
+                  help='host:port — run as ONE of --nprocs '
+                       'jax.distributed processes, each loading ONLY '
+                       'its own partitions (the reference per-rank '
+                       'loading discipline, dist_train_rgnn.py)')
+  ap.add_argument('--nprocs', type=int, default=1)
+  ap.add_argument('--rank', type=int, default=0)
   args = ap.parse_args()
 
+  multihost = args.coordinator is not None
+  if multihost and args.num_devices % args.nprocs:
+    raise SystemExit(f'--num-devices {args.num_devices} must divide '
+                     f'evenly over --nprocs {args.nprocs}')
   if args.cpu_mesh:
+    per_proc = (args.num_devices // args.nprocs if multihost
+                else args.num_devices)
     os.environ['XLA_FLAGS'] = (
         os.environ.get('XLA_FLAGS', '') +
-        f' --xla_force_host_platform_device_count={args.num_devices}')
+        f' --xla_force_host_platform_device_count={per_proc}')
   import jax
   if args.cpu_mesh:
     jax.config.update('jax_platforms', 'cpu')
+  if multihost:
+    from glt_tpu.parallel.multihost import initialize
+    initialize(coordinator_address=args.coordinator,
+               num_processes=args.nprocs, process_id=args.rank)
   import jax.numpy as jnp
   import numpy as np
   import optax
   from glt_tpu.distributed import (
       DistDataset, DistFeature, DistHeteroGraph, DistHeteroTrainStep,
+      dist_feature_from_partitions_multihost,
+      dist_hetero_graph_from_partitions_multihost,
   )
   from glt_tpu.models import RGNN
   from glt_tpu.parallel import make_mesh
@@ -107,12 +132,22 @@ def main():
   from glt_tpu.utils.checkpoint import restore_checkpoint, save_checkpoint
   from glt_tpu.utils.mlperf_logging import MLLogger
 
-  mll = MLLogger(benchmark='gnn')
+  # one MLLOG stream per job: non-zero ranks emit nothing
+  mll = MLLogger(benchmark='gnn',
+                 emit=(print if not multihost or args.rank == 0
+                       else (lambda *_: None)))
   mll.run_start()
 
   root = args.data_root
-  if root is None:
-    root = tempfile.mkdtemp(prefix='igbh_data_')
+  have_data = root is not None and os.path.exists(
+      os.path.join(root, 'processed', 'meta.txt'))
+  if not have_data:
+    if multihost:
+      raise SystemExit('--coordinator mode needs a pre-built shared '
+                       '--data-root (each process would otherwise '
+                       'synthesize a different dataset)')
+    if root is None:
+      root = tempfile.mkdtemp(prefix='igbh_data_')
     from compress_graph import compress, synthesize
     from split_seeds import split_seeds
     print(f'synthesizing IGBH-layout data at {args.papers} papers...')
@@ -121,7 +156,8 @@ def main():
     # compress() is consumed; the topology pass is for --data-root users
     compress(root, layout='CSC', bf16=args.bf16, topology=False)
     split_seeds(root)
-  counts, edges, feats, labels, train_idx, val_idx = load_igbh_root(root)
+  counts, edges, feats, labels, train_idx, val_idx = load_igbh_root(
+      root, load_feats=not multihost)
   log_rss('data loaded')
   num_classes = int(labels.max()) + 1
   total_edges = sum(e.shape[1] for e in edges.values())
@@ -141,26 +177,38 @@ def main():
       rev[(d, f'rev_{r}', s)] = ei[::-1].copy()
   edges.update(rev)
 
-  part_root = tempfile.mkdtemp(prefix='igbh_parts_')
-  print('partitioning...')
-  # partition blocks travel as f32 (npz cannot express bf16); the device
-  # store below re-casts to bf16, which is where the HBM savings matter
-  part_feats = {t: np.asarray(f, dtype=np.float32)
-                for t, f in feats.items()}
-  RandomPartitioner(part_root, num_parts=args.num_devices,
-                    num_nodes=dict(counts), edge_index=edges,
-                    node_feat=part_feats).partition()
-  del part_feats
+  part_root = args.part_root or tempfile.mkdtemp(prefix='igbh_parts_')
+  have_parts = os.path.exists(os.path.join(part_root, 'META.json'))
+  if multihost and not have_parts:
+    raise SystemExit('--coordinator mode needs a pre-built --part-root '
+                     '(run once without --coordinator, or rank-0-only, '
+                     'to partition first)')
+  if not have_parts:
+    print('partitioning...')
+    # partition blocks travel as f32 (npz cannot express bf16); the
+    # device store below re-casts to bf16, where the HBM savings matter
+    part_feats = {t: np.asarray(f, dtype=np.float32)
+                  for t, f in feats.items()}
+    RandomPartitioner(part_root, num_parts=args.num_devices,
+                      num_nodes=dict(counts), edge_index=edges,
+                      node_feat=part_feats).partition()
+    del part_feats
   log_rss('partitioned')
 
   mesh = make_mesh(args.num_devices)
-  dg = DistHeteroGraph.from_dataset_partitions(mesh, part_root)
-  dss = [DistDataset().load(part_root, p)
-         for p in range(args.num_devices)]
   dtype = jnp.bfloat16 if args.bf16 else None
-  dfeats = {t: DistFeature.from_dist_datasets(mesh, dss, ntype=t,
-                                              dtype=dtype)
-            for t in counts}
+  if multihost:
+    # each process loads ONLY its local devices' partitions
+    dg = dist_hetero_graph_from_partitions_multihost(mesh, part_root)
+    dfeats = {t: dist_feature_from_partitions_multihost(
+        mesh, part_root, ntype=t, dtype=dtype) for t in counts}
+  else:
+    dg = DistHeteroGraph.from_dataset_partitions(mesh, part_root)
+    dss = [DistDataset().load(part_root, p)
+           for p in range(args.num_devices)]
+    dfeats = {t: DistFeature.from_dist_datasets(mesh, dss, ntype=t,
+                                                dtype=dtype)
+              for t in counts}
   label_dict = {'paper': labels}
 
   model = RGNN(edge_types=[reverse_edge_type(e) for e in edges],
@@ -208,7 +256,9 @@ def main():
                                jax.random.key(global_step))
       global_step += 1
       if it % 20 == 0:
-        l = float(np.asarray(loss)[0])
+        # loss is mesh-sharded (every lane equal); read a LOCAL shard
+        # so multihost processes can fetch it
+        l = float(np.asarray(loss.addressable_shards[0].data)[0])
         dt = time.time() - t_start
         print(f'epoch {epoch} step {it}/{per_epoch}: loss={l:.4f} '
               f'({global_step * n_dev * bs / max(dt, 1e-9):.0f} '

@@ -16,6 +16,7 @@ share-via-handle machinery is unnecessary by design.
 """
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 import jax
@@ -45,8 +46,26 @@ class Graph:
     self._edge_ids = None
     self._edge_weights = None
     self._initialized = False
-    import threading
     self._window_cache = {}   # field -> (padded_width, array)
+    self._window_lock = threading.Lock()
+
+  # threading.Lock is unpicklable; producers currently ship a
+  # dataset_builder callable rather than Graph objects, but mp channel
+  # payloads / checkpoints may pickle a Graph directly. Device arrays and
+  # the window cache are dropped too: they are lazily rebuilt, and a
+  # fresh process must re-place them on its own devices anyway.
+  def __getstate__(self):
+    state = self.__dict__.copy()
+    state['_window_lock'] = None
+    state['_window_cache'] = {}
+    if self.mode == GraphMode.HBM:
+      state['_indptr'] = state['_indices'] = None
+      state['_edge_ids'] = state['_edge_weights'] = None
+      state['_initialized'] = False
+    return state
+
+  def __setstate__(self, state):
+    self.__dict__.update(state)
     self._window_lock = threading.Lock()
 
   # -- lazy init ---------------------------------------------------------
@@ -95,11 +114,17 @@ class Graph:
     """Edge arrays padded by ``width`` trailing sentinel elements — the
     precondition of the Pallas window-DMA gather
     (ops/pallas_kernels.py::gather_windows): every [start, start+width)
-    window of a real row then lies fully inside the array. Each padded
-    field is an extra device copy of that edge array, so callers name
-    only the fields they read (the weighted path needs just
-    ``edge_weights``); entries are cached per (width, field) and are
-    None where the source array is None.
+    window of a real row then lies fully inside the array. The padded
+    copy SUPERSEDES the original device array (``self._<field>`` is
+    rebound to it and the original freed): row gathers address the same
+    logical prefix and the clip bounds only loosen, so one resident copy
+    serves both the window-DMA and XLA-gather paths — at papers100M
+    scale a duplicate edge array would cost ~GBs of HBM. Peak transient
+    HBM during the rebind is ~2x the field (concatenate reads old,
+    writes new), same as the old steady state. Callers name only the
+    fields they read (the weighted path needs just ``edge_weights``);
+    entries are cached per (width, field), grown to the max width ever
+    asked, and are None where the source array is None.
     """
     if self.mode != GraphMode.HBM:
       # jnp.concatenate below would silently device-place a HOST-mode
@@ -123,9 +148,13 @@ class Graph:
           if a is None:
             have = (width, None)
           else:
-            a = jnp.asarray(a)
-            have = (width, jnp.concatenate(
-                [a, jnp.full((width,), fills[f], a.dtype)]))
+            # logical prefix: when growing an existing padded copy the
+            # stored array already carries the previous width's tail
+            a = jnp.asarray(a)[:self.num_edges]
+            padded = jnp.concatenate(
+                [a, jnp.full((width,), fills[f], a.dtype)])
+            setattr(self, '_' + f, padded)  # supersede: one HBM copy
+            have = (width, padded)
           self._window_cache[f] = have
         out[f] = have[1]
     return out

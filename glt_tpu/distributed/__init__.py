@@ -64,5 +64,7 @@ from .dist_graph import dist_graph_from_partitions_multihost
 
 __all__ += ['dist_graph_from_partitions_multihost']
 from .dist_feature import dist_feature_from_partitions_multihost
+from .dist_hetero import dist_hetero_graph_from_partitions_multihost
+__all__ += ['dist_hetero_graph_from_partitions_multihost']
 
 __all__ += ['dist_feature_from_partitions_multihost']

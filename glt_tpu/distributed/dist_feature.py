@@ -140,6 +140,11 @@ class DistFeature:
     # replays the routing with _host_pb, which __init__ retains
     # whenever bucket_cap is set
     self.bucket_cap = int(bucket_cap)
+    # the cap is baked into the shard_map trace on first lookup; a later
+    # mutation would desync the host drain replay from the compiled
+    # device routing (silently double-serving lanes) — record the cap
+    # actually traced and refuse mismatched lookups (see lookup())
+    self._traced_cap = None
     self._hot_counts_dev = jnp.asarray(self.hot_counts)
     # compiled once; rebuilding shard_map per call would re-trace
     self._lookup_fn = jax.jit(jax.shard_map(
@@ -205,6 +210,15 @@ class DistFeature:
     with host spill, flagged cold lanes are resolved from the host
     shards at the end. Both compose: a lane that overflowed in round k
     and turns out cold in round k+1 still resolves exactly once."""
+    if self._traced_cap is None:
+      self._traced_cap = self.bucket_cap
+    elif self.bucket_cap != self._traced_cap:
+      raise RuntimeError(
+          f'bucket_cap changed from {self._traced_cap} to '
+          f'{self.bucket_cap} after the first lookup compiled it in; '
+          'the cached device routing would no longer match the host '
+          'drain replay (double-serving lanes). Set bucket_cap before '
+          'the first lookup, or build a new store.')
     ids_np = as_numpy(ids).astype(np.int64)
     ids = jnp.asarray(ids_np, jnp.int32)
     if valid is None:

@@ -138,8 +138,12 @@ class DistGraph:
 
 
 def _build_partition_block(g, num_nodes: int, edge_dir: str,
-                           with_weights: bool = False):
-  """One partition's padded-ready CSR pieces (pre-padding)."""
+                           with_weights: bool = False,
+                           num_cols: int = None):
+  """One partition's padded-ready CSR pieces (pre-padding).
+
+  ``num_nodes`` is the ROW id space; ``num_cols`` defaults to it and
+  differs for hetero etype stores (col type's id space)."""
   src, dst = as_numpy(g.edge_index)
   row, col = (src, dst) if edge_dir == 'out' else (dst, src)
   owned = np.unique(row)
@@ -150,8 +154,17 @@ def _build_partition_block(g, num_nodes: int, edge_dir: str,
                   edge_weights=(as_numpy(g.weights) if with_weights
                                 else None),
                   layout='CSR',
-                  num_rows=owned.shape[0], num_cols=num_nodes)
+                  num_rows=owned.shape[0],
+                  num_cols=num_nodes if num_cols is None else num_cols)
   return topo, local_of
+
+
+def _stack_or_empty(parts, width, dtype):
+  """Stack this process's blocks; empty [0, width] when it owns none
+  (make_array_from_process_local_data still needs the trailing dims)."""
+  if parts:
+    return np.stack(parts)
+  return np.zeros((0, width), dtype)
 
 
 def _pad_block(topo, local_of, max_rows: int, max_edges: int):
@@ -204,16 +217,23 @@ def dist_graph_from_partitions_multihost(mesh, root_dir: str,
   node_pb = None
   blocks = {}
   parts_raw = {}
-  local_max = np.zeros(3, np.int64)  # rows, edges, degree
+  # rows, edges, degree maxima + a weights-presence bit: ALL of these
+  # steer collective array construction, so every process must agree —
+  # a shard-less process in particular must not locally conclude
+  # "no weights" while peers build the weights array (mismatched
+  # participation in make_array_from_process_local_data hangs the job)
+  local_max = np.zeros(3, np.int64)
+  local_has_w = 1
   for p in mine:
     _, g, _, _, npb, _ = load_partition(root_dir, p)
     node_pb = npb
     parts_raw[p] = g
-  has_weights = bool(parts_raw) and all(
-      g.weights is not None for g in parts_raw.values())
+    if g.weights is None:
+      local_has_w = 0
   for p, g in parts_raw.items():
     topo, local_of = _build_partition_block(
-        g, node_pb.table.shape[0], edge_dir, with_weights=has_weights)
+        g, node_pb.table.shape[0], edge_dir,
+        with_weights=g.weights is not None)
     blocks[p] = (topo, local_of)
     local_max = np.maximum(
         local_max, [topo.num_rows, topo.num_edges, topo.max_degree])
@@ -223,10 +243,13 @@ def dist_graph_from_partitions_multihost(mesh, root_dir: str,
 
   if jax.process_count() > 1:
     from jax.experimental import multihost_utils
-    all_max = multihost_utils.process_allgather(jnp.asarray(local_max))
-    gmax = np.asarray(all_max).max(axis=0)
+    gathered = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray(np.concatenate([local_max, [local_has_w]]))))
+    gmax = gathered[:, :3].max(axis=0)
+    has_weights = bool(gathered[:, 3].min())
   else:
     gmax = local_max
+    has_weights = bool(parts_raw) and bool(local_has_w)
   max_rows = max(int(gmax[0]), 1)
   max_edges = max(int(gmax[1]), 1)
 
@@ -241,25 +264,20 @@ def dist_graph_from_partitions_multihost(mesh, root_dir: str,
     if has_weights:
       weights_l.append(w)
 
-  def stack_or_empty(parts, width, dtype):
-    if parts:
-      return np.stack(parts)
-    return np.zeros((0, width), dtype)
-
   store = DistGraph.__new__(DistGraph)
   store._finish_init(mesh, axis, num_nodes, edge_dir, n_parts,
                      max_rows, max_edges, max(int(gmax[2]), 1))
   store.indptr = global_from_local(
-      mesh, stack_or_empty(ips, max_rows + 1, np.int32), axis)
+      mesh, _stack_or_empty(ips, max_rows + 1, np.int32), axis)
   store.indices = global_from_local(
-      mesh, stack_or_empty(inds, max_edges, np.int32), axis)
+      mesh, _stack_or_empty(inds, max_edges, np.int32), axis)
   store.edge_ids = global_from_local(
-      mesh, stack_or_empty(eids_l, max_edges, np.int64), axis)
+      mesh, _stack_or_empty(eids_l, max_edges, np.int64), axis)
   store.edge_weights = (global_from_local(
-      mesh, stack_or_empty(weights_l, max_edges, np.float32), axis)
+      mesh, _stack_or_empty(weights_l, max_edges, np.float32), axis)
       if has_weights else None)
   store.local_row = global_from_local(
-      mesh, stack_or_empty(locals_l, num_nodes, np.int32), axis)
+      mesh, _stack_or_empty(locals_l, num_nodes, np.int32), axis)
   store.node_pb = jax.device_put(
       _pb_dense(node_pb, num_nodes), NamedSharding(mesh, P()))
   return store

@@ -153,6 +153,131 @@ class DistHeteroGraph:
                edge_dir=edge_dir, axis=axis)
 
 
+def dist_hetero_graph_from_partitions_multihost(
+    mesh: Mesh, root_dir: str, edge_dir: str = 'out',
+    axis: str = 'data') -> DistHeteroGraph:
+  """Multi-host DistHeteroGraph: each process loads ONLY the partitions
+  owned by its local devices and contributes per-etype blocks to the
+  global sharded stacks (jax.make_array_from_process_local_data) — the
+  hetero counterpart of dist_graph_from_partitions_multihost, and the
+  reference's per-rank partition loading discipline for IGBH-class
+  training (dist_train_rgnn.py loads rank-local partitions only).
+
+  Padding widths (max rows/edges/degree per etype) are agreed with one
+  allgather so every process lowers the identical SPMD program.
+  """
+  import jax
+  from ..parallel.multihost import global_from_local
+  from ..partition import load_meta, load_partition
+  from .dist_graph import (
+      DistGraph, _build_partition_block, _pad_block, _pb_dense,
+      _stack_or_empty,
+  )
+  meta = load_meta(root_dir)
+  assert meta['data_cls'] == 'hetero'
+  need = 'by_src' if edge_dir == 'out' else 'by_dst'
+  got = meta.get('edge_assign', 'by_src')
+  if got != need:
+    raise ValueError(
+        f'partition was edge-assigned {got!r} but edge_dir='
+        f'{edge_dir!r} sampling requires {need!r}')
+  etypes = [tuple(e) for e in meta['edge_types']]
+  devices = mesh.devices.reshape(-1)
+  n_parts = devices.shape[0]
+  if meta['num_parts'] != n_parts:
+    raise ValueError(
+        f"mesh has {n_parts} devices but the partition dir holds "
+        f"{meta['num_parts']} partitions — they must match")
+  mine = [i for i, d in enumerate(devices)
+          if d.process_index == jax.process_index()]
+
+  node_pbs = None
+  parts_raw = {}
+  for p in mine:
+    _, graphs, _, _, npb, _ = load_partition(root_dir, p)
+    node_pbs = npb
+    parts_raw[p] = graphs
+  if node_pbs is None:  # a process with no shards still needs the PBs
+    _, _, _, _, node_pbs, _ = load_partition(root_dir, 0)
+  node_counts = {nt: pb.table.shape[0] for nt, pb in node_pbs.items()}
+
+  # per-etype local blocks + maxima; weights-presence must also be
+  # agreed globally (all-or-nothing per etype)
+  blocks = {e: {} for e in etypes}
+  local_stats = np.zeros((len(etypes), 4), np.int64)  # rows,edges,deg,w
+  local_stats[:, 3] = 1
+  for p, graphs in parts_raw.items():
+    for i, e in enumerate(etypes):
+      src_t, _, dst_t = e
+      g = graphs[e]
+      row_t = src_t if edge_dir == 'out' else dst_t
+      col_t = dst_t if edge_dir == 'out' else src_t
+      topo, local_of = _build_partition_block(
+          g, node_counts[row_t], edge_dir,
+          with_weights=g.weights is not None,
+          num_cols=node_counts[col_t])
+      blocks[e][p] = (topo, local_of)
+      local_stats[i, :3] = np.maximum(
+          local_stats[i, :3],
+          [topo.num_rows, topo.num_edges, topo.max_degree])
+      if g.weights is None:
+        local_stats[i, 3] = 0
+  if jax.process_count() > 1:
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    gathered = np.asarray(
+        multihost_utils.process_allgather(jnp.asarray(local_stats)))
+    stats = np.concatenate([gathered[..., :3].max(axis=0),
+                            gathered[..., 3:].min(axis=0)], axis=-1)
+  else:
+    stats = local_stats
+
+  out = DistHeteroGraph.__new__(DistHeteroGraph)
+  out.mesh = mesh
+  out.axis = axis
+  out.edge_dir = edge_dir
+  out.node_counts = node_counts
+  out.num_partitions = n_parts
+  out.graphs = {}
+  for i, e in enumerate(etypes):
+    src_t, _, dst_t = e
+    row_t = src_t if edge_dir == 'out' else dst_t
+    max_rows = max(int(stats[i, 0]), 1)
+    max_edges = max(int(stats[i, 1]), 1)
+    has_w = bool(stats[i, 3])
+    ips, inds, eids_l, locals_l, weights_l = [], [], [], [], []
+    for p in mine:
+      topo, local_of = blocks[e][p]
+      ip, ind, eid, w, lo = _pad_block(topo, local_of, max_rows,
+                                       max_edges)
+      ips.append(ip)
+      inds.append(ind)
+      eids_l.append(eid)
+      locals_l.append(lo)
+      if has_w:
+        weights_l.append(w)
+    store = DistGraph.__new__(DistGraph)
+    store._finish_init(mesh, axis, node_counts[row_t], 'out', n_parts,
+                       max_rows, max_edges, max(int(stats[i, 2]), 1))
+    store.indptr = global_from_local(
+        mesh, _stack_or_empty(ips, max_rows + 1, np.int32), axis)
+    store.indices = global_from_local(
+        mesh, _stack_or_empty(inds, max_edges, np.int32), axis)
+    store.edge_ids = global_from_local(
+        mesh, _stack_or_empty(eids_l, max_edges, np.int64), axis)
+    store.edge_weights = (global_from_local(
+        mesh, _stack_or_empty(weights_l, max_edges, np.float32), axis)
+        if has_w else None)
+    store.local_row = global_from_local(
+        mesh, _stack_or_empty(locals_l, node_counts[row_t], np.int32),
+        axis)
+    store.node_pb = jax.device_put(
+        _pb_dense(node_pbs[row_t], node_counts[row_t]),
+        NamedSharding(mesh, P()))
+    out.graphs[e] = store
+  return out
+
+
 class DistHeteroNeighborSampler:
   """SPMD hetero sampling: per-device seed batches of one seed type."""
 
@@ -692,4 +817,8 @@ class DistHeteroTrainStep:
     keys = jax.random.split(key, n_dev)
     self.sampler.tables, correct, total = self._eval_fn(
         params, self.sampler.tables, seeds, nv, keys)
-    return int(np.asarray(correct)[0]), int(np.asarray(total)[0])
+    # every lane carries the same psum; read a process-LOCAL shard so
+    # multihost runs (where the global array spans other processes)
+    # can fetch it
+    return (int(np.asarray(correct.addressable_shards[0].data)[0]),
+            int(np.asarray(total.addressable_shards[0].data)[0]))

@@ -115,6 +115,11 @@ class NodeLoader:
     # design pulled the hot gather D2H and re-uploaded the whole batch —
     # hot rows crossed PCIe twice, defeating the split.
     rows_np = as_numpy(rows).astype(np.int64)
+    if feat.hot_count == 0:
+      # no device block at all (split_ratio=0.0): the whole batch is
+      # cold; an empty jnp.take would raise, so serve host-side only
+      return jnp.asarray(feat.gather_cold_host(rows_np)
+                         .astype(feat.dtype))
     rows_dev = jnp.asarray(rows_np)
     hot = jnp.where(rows_dev < feat.hot_count, rows_dev, 0)
     x = feat.device_gather(hot)                  # [B, D], cold lanes junk

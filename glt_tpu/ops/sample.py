@@ -265,11 +265,14 @@ def neighbor_probs(
                      jnp.minimum(fanout / jnp.maximum(deg, 1.0), 1.0),
                      0.0)
   contrib_per_src = seed_probs * rate                     # [N]
-  # expand to edges: edge e has src = row(e)
-  rows = jnp.searchsorted(indptr, jnp.arange(indices.shape[0],
-                                             dtype=indptr.dtype),
-                          side='right') - 1
-  contrib = jnp.take(contrib_per_src, rows)
+  # expand to edges: edge e has src = row(e). ``indices`` may carry a
+  # sentinel-padded tail (Graph.window_arrays supersedes the original
+  # with the window-padded copy); positions at/after indptr[-1] are not
+  # edges — zero their contribution and clamp the sentinel (-1) ids.
+  pos = jnp.arange(indices.shape[0], dtype=indptr.dtype)
+  rows = jnp.searchsorted(indptr, pos, side='right') - 1
+  contrib = jnp.take(contrib_per_src, rows, mode='clip')
+  contrib = jnp.where(pos < indptr[-1], contrib, 0.0)
   out = jnp.zeros((num_nodes,), jnp.float32)
-  out = out.at[indices].add(contrib)
+  out = out.at[jnp.maximum(indices, 0)].add(contrib)
   return jnp.minimum(out, 1.0)

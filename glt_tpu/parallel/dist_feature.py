@@ -116,6 +116,10 @@ class ShardedFeature:
     # SAME compiled program — the bucketing is deterministic, so the
     # host replays it to decide how many rounds are needed (usually 1).
     self.bucket_cap = int(bucket_cap)
+    # cap is baked into the shard_map trace on first lookup; mutating it
+    # later would desync the host drain from the compiled routing —
+    # lookup() records the traced value and rejects mismatches
+    self._traced_cap = None
     # host spill (reference unified_tensor.cu:202-231 pinned-CPU shard):
     # rows [hot_count, rows_per_shard) of EVERY shard stay host-side;
     # the uniform per-shard split keeps hot-ness arithmetic, so the
@@ -224,6 +228,15 @@ class ShardedFeature:
   def lookup(self, ids, valid=None) -> jax.Array:
     """Whole-mesh lookup from the host side: ids [n_shards * B] laid out
     shard-major; returns globally-sharded [n_shards * B, D]."""
+    if self._traced_cap is None:
+      self._traced_cap = self.bucket_cap
+    elif self.bucket_cap != self._traced_cap:
+      raise RuntimeError(
+          f'bucket_cap changed from {self._traced_cap} to '
+          f'{self.bucket_cap} after the first lookup compiled it in; '
+          'the cached device routing would no longer match the host '
+          'drain replay. Set bucket_cap before the first lookup, or '
+          'build a new ShardedFeature.')
     ids_np = as_numpy(ids).astype(np.int64)
     ids = jnp.asarray(ids_np)
     if valid is None:

@@ -186,20 +186,28 @@ class NeighborSampler(BaseSampler):
 
   def _one_hop(self, g: Graph, frontier, fanout, key, mask):
     """Dispatch full/uniform/weighted one-hop sampling on graph ``g``."""
-    eids = g.edge_ids if self.with_edge else None
     if fanout < 0:  # full neighborhood inside a |fanout|-wide window
+      # build window kwargs BEFORE touching g.indices/edge_ids: the
+      # padded window copy supersedes the originals (Graph.window_arrays
+      # rebinds the fields), so reading them afterwards keeps the
+      # compiled program referencing ONE resident copy per edge array
+      want_eids = self.with_edge and g.topo.edge_ids is not None
+      wk = self._window_kwargs(
+          g, -fanout, ('indices', 'edge_ids') if want_eids
+          else ('indices',))
+      eids = g.edge_ids if self.with_edge else None
       return sample_full_neighbors(
           g.indptr, g.indices, frontier, -fanout, seed_mask=mask,
-          edge_ids=eids, **self._window_kwargs(
-              g, -fanout, ('indices', 'edge_ids') if eids is not None
-              else ('indices',)))
+          edge_ids=eids, **wk)
     if self.with_weight and g.edge_weights is not None:
       max_deg = self.max_weighted_degree or g.topo.max_degree
       max_deg = max(max_deg, fanout)
+      wk = self._window_kwargs(g, max_deg, ('edge_weights',))
+      eids = g.edge_ids if self.with_edge else None
       return sample_neighbors_weighted(
           g.indptr, g.indices, g.edge_weights, frontier, fanout, key,
-          max_degree=max_deg, seed_mask=mask, edge_ids=eids,
-          **self._window_kwargs(g, max_deg, ('edge_weights',)))
+          max_degree=max_deg, seed_mask=mask, edge_ids=eids, **wk)
+    eids = g.edge_ids if self.with_edge else None
     return sample_neighbors(
         g.indptr, g.indices, frontier, fanout, key, seed_mask=mask,
         edge_ids=eids, replace=self.replace)

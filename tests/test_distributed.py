@@ -203,6 +203,51 @@ def test_dist_hetero_sampler(tmp_path_factory, mesh):
   assert ('item', 'rev_u2i', 'user') in out['row']
 
 
+def test_dist_hetero_multihost_builder_parity(tmp_path_factory, mesh):
+  # single-process path of the multihost hetero builder must produce a
+  # store whose sampling matches from_dataset_partitions exactly
+  from glt_tpu.distributed import (
+      DistHeteroGraph, DistHeteroNeighborSampler,
+      dist_hetero_graph_from_partitions_multihost,
+  )
+  root = str(tmp_path_factory.mktemp('hetero_mh_parts'))
+  u2i = ('user', 'u2i', 'item')
+  i2i = ('item', 'i2i', 'item')
+  nu, ni = 16, 32
+  u = np.arange(nu)
+  u2i_ei = np.stack([np.repeat(u, 2),
+                     np.stack([2*u, 2*u+1], 1).reshape(-1) % ni])
+  i = np.arange(ni)
+  i2i_ei = np.stack([np.repeat(i, 2),
+                     np.stack([(i+1) % ni, (i+2) % ni], 1).reshape(-1)])
+  RandomPartitioner(root, num_parts=N_PARTS,
+                    num_nodes={'user': nu, 'item': ni},
+                    edge_index={u2i: u2i_ei, i2i: i2i_ei}).partition()
+  ref = DistHeteroGraph.from_dataset_partitions(mesh, root)
+  got = dist_hetero_graph_from_partitions_multihost(mesh, root)
+  assert got.node_counts == ref.node_counts
+  for e in ref.graphs:
+    a, b = ref.graphs[e], got.graphs[e]
+    assert (a.max_rows, a.max_edges, a.max_degree) == \
+        (b.max_rows, b.max_edges, b.max_degree), e
+    np.testing.assert_array_equal(np.asarray(a.indptr),
+                                  np.asarray(b.indptr), str(e))
+    np.testing.assert_array_equal(np.asarray(a.indices),
+                                  np.asarray(b.indices), str(e))
+    np.testing.assert_array_equal(np.asarray(a.local_row),
+                                  np.asarray(b.local_row), str(e))
+  seeds = (np.arange(N_PARTS) % nu)[:, None]
+  out_a = DistHeteroNeighborSampler(
+      ref, {u2i: [2, 2], i2i: [2, 2]}, seed=0).sample_from_nodes(
+          'user', seeds, key=jax.random.key(3))
+  out_b = DistHeteroNeighborSampler(
+      got, {u2i: [2, 2], i2i: [2, 2]}, seed=0).sample_from_nodes(
+          'user', seeds, key=jax.random.key(3))
+  for t in out_a['node']:
+    np.testing.assert_array_equal(np.asarray(out_a['node'][t]),
+                                  np.asarray(out_b['node'][t]), t)
+
+
 def test_dist_hetero_train_step(tmp_path_factory, mesh):
   import optax
   from glt_tpu.distributed import (
@@ -916,4 +961,17 @@ def test_dist_feature_bucket_cap_post_hoc_rejected(mesh, dist_datasets):
   df.bucket_cap = 4
   ids = np.zeros(N_PARTS * 16, np.int64)  # hot-spot: forces overflow
   with pytest.raises(RuntimeError, match='routing books'):
+    df.lookup(ids)
+
+
+def test_dist_feature_bucket_cap_mutation_after_trace_rejected(
+    mesh, dist_datasets):
+  # the first lookup bakes the cap into the shard_map trace; mutating
+  # it afterwards would double-serve lanes (cached uncapped trace +
+  # host drain rounds) — must raise, not silently corrupt
+  df = DistFeature.from_dist_datasets(mesh, dist_datasets, bucket_cap=4)
+  ids = np.arange(N_PARTS * 16, dtype=np.int64) % N_NODES
+  df.lookup(ids)
+  df.bucket_cap = 8
+  with pytest.raises(RuntimeError, match='bucket_cap changed'):
     df.lookup(ids)

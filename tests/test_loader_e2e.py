@@ -75,6 +75,21 @@ def test_split_feature_store_loader(ring=None):
     np.testing.assert_allclose(np.asarray(b.x)[:nc, 0], nodes)
 
 
+def test_all_cold_feature_store_loader():
+  # split_ratio=0.0: no device block at all — the whole batch must be
+  # served host-side (ADVICE r3: the unconditional device_gather raised
+  # on the empty hot block)
+  ds = ring_dataset(num_nodes=40, split_ratio=0.0)
+  feat = ds.get_node_feature()
+  assert feat.hot_count == 0 and not feat.fully_device_resident
+  loader = NeighborLoader(ds, [2], input_nodes=np.arange(40),
+                          batch_size=8, seed=0)
+  for b in loader:
+    nc = int(b.node_count)
+    nodes = np.asarray(b.node)[:nc]
+    np.testing.assert_allclose(np.asarray(b.x)[:nc, 0], nodes)
+
+
 def test_training_learns():
   """GraphSAGE learns y = node_id % 4 from one-hot features (solvable by
   memorization through the conv's root path; exercises the full

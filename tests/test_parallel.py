@@ -230,6 +230,17 @@ def test_sharded_feature_bucket_cap_parity(mesh):
   np.testing.assert_allclose(a, b)
 
 
+def test_sharded_feature_bucket_cap_mutation_after_trace_rejected(mesh):
+  n, d = 64, 4
+  feats = np.arange(n * d, dtype=np.float32).reshape(n, d)
+  sf = ShardedFeature(feats, mesh, bucket_cap=4)
+  ids = np.arange(8 * 16, dtype=np.int64) % n
+  sf.lookup(ids)
+  sf.bucket_cap = 2
+  with pytest.raises(RuntimeError, match='bucket_cap changed'):
+    sf.lookup(ids)
+
+
 def test_sharded_feature_bucket_cap_hot_spot(mesh):
   # worst-case skew: every device asks shard 0 for its whole batch —
   # the drain must run ceil(B/C) rounds and still be exact
