@@ -1,0 +1,38 @@
+#!/bin/bash
+# Serial CPU evidence chain (1-core box: never run two heavy steps at
+# once). Each step writes its JSON artifact under benchmarks/results/.
+# TPU-independent counterpart of run_tpu_suite.sh — the epoch-protocol,
+# convergence, spill, drain-grid, and IGBH-profile artifacts VERDICT r3
+# asks for, runnable while the tunnel is wedged.
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/results
+mkdir -p "$OUT"
+export GLT_BENCH_PLATFORM=cpu
+
+run() {  # run NAME CMD...
+  local name=$1; shift
+  echo "== $(date -Is) $name: $*" >> "$OUT/evidence_chain.log"
+  timeout 14400 "$@" > "$OUT/$name.json" 2> "$OUT/$name.err"
+  echo "== $(date -Is) $name done rc=$? $(tail -c 120 "$OUT/$name.json")" \
+      >> "$OUT/evidence_chain.log"
+}
+
+# 1. north-star epoch protocol, products scale, ONE full epoch timed
+run bench_train_products_cpu python benchmarks/bench_train.py --epochs 1
+
+# 2. convergence curve to plateau, reduced scale, same protocol shapes
+run bench_train_curve_cpu python benchmarks/bench_train.py \
+    --num-nodes 200000 --avg-degree 15 --batch-size 512 \
+    --plateau 3 --epochs 40
+
+# 3. beyond-HBM spill training ratio (scaled-down on CPU)
+run bench_spill_cpu python benchmarks/bench_spill_train.py
+
+# 4. capped-bucket drain grid
+run bench_bucket_drain_cpu python benchmarks/bench_bucket_drain.py
+
+# 5. IGBH step breakdown at 1M papers
+run profile_igbh_cpu python benchmarks/profile_igbh.py --papers 1000000
+
+echo "== $(date -Is) chain complete" >> "$OUT/evidence_chain.log"

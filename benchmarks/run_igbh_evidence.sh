@@ -1,0 +1,28 @@
+#!/bin/bash
+# IGBH scale evidence (VERDICT r3 next #5), serial on the 1-core box:
+#   1. full-epoch 54M-edge run (4M papers), eval every epoch, reusing
+#      one synthesized data tree + partition dir across runs;
+#   2. >=200M-edge single-step memory probe (per-host RSS wall).
+# Batch size is taken from $IGBH_BS (default 256/device — set from the
+# profile_igbh breakdown before launching).
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/results
+mkdir -p "$OUT"
+BS=${IGBH_BS:-256}
+DATA=${IGBH_DATA:-/tmp/igbh_data_4m}
+PARTS=${IGBH_PARTS:-/tmp/igbh_parts_4m}
+
+echo "== $(date -Is) igbh epoch: bs=$BS" >> "$OUT/evidence_chain.log"
+timeout 36000 python examples/igbh/dist_train_rgnn.py \
+    --papers 4000000 --data-root "$DATA" --part-root "$PARTS" \
+    --epochs 1 --batch-size "$BS" --val-batches 20 \
+    > "$OUT/igbh_epoch_54m.log" 2>&1
+echo "== $(date -Is) igbh epoch done rc=$?" >> "$OUT/evidence_chain.log"
+
+echo "== $(date -Is) igbh 200M probe" >> "$OUT/evidence_chain.log"
+timeout 14400 python examples/igbh/dist_train_rgnn.py \
+    --papers 15000000 --epochs 1 --steps-per-epoch 1 --batch-size 64 \
+    --val-batches 1 \
+    > "$OUT/igbh_probe_200m.log" 2>&1
+echo "== $(date -Is) igbh 200M probe done rc=$?" >> "$OUT/evidence_chain.log"

@@ -29,7 +29,7 @@ class NeighborLoader(NodeLoader):
                replace: bool = False,
                seed: Optional[int] = None,
                device=None,
-               prefetch_depth: int = 0,
+               prefetch_depth: Optional[int] = None,
                as_pyg_v1: bool = False,
                rng: Optional[np.random.Generator] = None):
     sampler = NeighborSampler(

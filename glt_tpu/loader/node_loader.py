@@ -43,7 +43,7 @@ class NodeLoader:
                shuffle: bool = False,
                drop_last: bool = False,
                collect_features: bool = True,
-               prefetch_depth: int = 0,
+               prefetch_depth: Optional[int] = None,
                rng: Optional[np.random.Generator] = None):
     self.data = data
     self.sampler = sampler
@@ -58,10 +58,30 @@ class NodeLoader:
     self.collect_features = collect_features
     #: >0 overlaps host batch prep (incl. cold-row gathers) with device
     #: compute via a prefetch thread — the in-process analogue of the
-    #: reference's producer/channel overlap
+    #: reference's producer/channel overlap. Default (None) = auto:
+    #: depth 2 when any feature store has a host phase (spill / HOST
+    #: residency — there is host work to hide), else 0 (fully
+    #: device-resident collate has nothing to overlap). Measured ratio:
+    #: benchmarks/bench_spill_train.py.
+    if prefetch_depth is None:
+      prefetch_depth = 2 if (collect_features
+                             and self._has_host_phase(data)) else 0
     self.prefetch_depth = int(prefetch_depth)
     self.rng = rng or np.random.default_rng(0)
     self._gather_cache = {}
+
+  @staticmethod
+  def _has_host_phase(data) -> bool:
+    """True when collation must touch host RAM per batch (spilled
+    feature rows), so a prefetch thread has latency to hide."""
+    stores = []
+    for feats in (data.node_features, data.edge_features):
+      if isinstance(feats, dict):
+        stores.extend(feats.values())
+      elif feats is not None:
+        stores.append(feats)
+    return any(getattr(f, 'fully_device_resident', True) is False
+               for f in stores)
 
   def __len__(self):
     n = self.seeds.shape[0]

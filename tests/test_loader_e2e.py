@@ -90,6 +90,28 @@ def test_all_cold_feature_store_loader():
     np.testing.assert_allclose(np.asarray(b.x)[:nc, 0], nodes)
 
 
+def test_prefetch_depth_auto_default():
+  # spilled stores have a host phase per batch -> overlap by default;
+  # fully resident stores have nothing to hide -> no prefetch thread
+  spilled = ring_dataset(num_nodes=40, split_ratio=0.3)
+  resident = ring_dataset(num_nodes=40)
+  l_spill = NeighborLoader(spilled, [2], input_nodes=np.arange(8),
+                           batch_size=8, seed=0)
+  l_res = NeighborLoader(resident, [2], input_nodes=np.arange(8),
+                         batch_size=8, seed=0)
+  assert l_spill.prefetch_depth == 2
+  assert l_res.prefetch_depth == 0
+  # explicit value still wins
+  l_off = NeighborLoader(spilled, [2], input_nodes=np.arange(8),
+                         batch_size=8, seed=0, prefetch_depth=0)
+  assert l_off.prefetch_depth == 0
+  # spilled loader still yields exact features through the prefetcher
+  for b in l_spill:
+    nc = int(b.node_count)
+    nodes = np.asarray(b.node)[:nc]
+    np.testing.assert_allclose(np.asarray(b.x)[:nc, 0], nodes)
+
+
 def test_training_learns():
   """GraphSAGE learns y = node_id % 4 from one-hot features (solvable by
   memorization through the conv's root path; exercises the full
