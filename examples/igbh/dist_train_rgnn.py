@@ -32,17 +32,20 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), '..'
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), '..', '..'))
 
 
-def load_igbh_root(root: str, load_feats: bool = True):
+def load_igbh_root(root: str, load_feats: bool = True,
+                   load_edges: bool = True):
   """Load the compress_graph/split_seeds output tree. ``load_feats=
-  False`` skips the full feature matrices (multihost mode builds the
-  stores from the per-rank partition blocks instead — loading the whole
-  table on every rank would defeat per-rank memory discipline)."""
+  False`` / ``load_edges=False`` skip the full feature matrices / edge
+  payloads (multihost mode builds the stores from the per-rank
+  partition blocks instead — loading whole tables on every rank would
+  defeat per-rank memory discipline; edge-type NAMES then come from the
+  partition dir's META.json)."""
   import numpy as np
   from compress_graph import load_meta
   proc = os.path.join(root, 'processed')
   counts = load_meta(root)
   edges = {}
-  for name in sorted(os.listdir(proc)):
+  for name in sorted(os.listdir(proc)) if load_edges else ():
     p = os.path.join(proc, name, 'edge_index.npy')
     if os.path.exists(p):
       s, r, d = name.split('__')
@@ -104,6 +107,8 @@ def main():
   if multihost and args.num_devices % args.nprocs:
     raise SystemExit(f'--num-devices {args.num_devices} must divide '
                      f'evenly over --nprocs {args.nprocs}')
+  if multihost and not args.part_root:
+    raise SystemExit('--coordinator mode needs a pre-built --part-root')
   if args.cpu_mesh:
     per_proc = (args.num_devices // args.nprocs if multihost
                 else args.num_devices)
@@ -157,25 +162,34 @@ def main():
     compress(root, layout='CSC', bf16=args.bf16, topology=False)
     split_seeds(root)
   counts, edges, feats, labels, train_idx, val_idx = load_igbh_root(
-      root, load_feats=not multihost)
+      root, load_feats=not multihost, load_edges=not multihost)
   log_rss('data loaded')
   num_classes = int(labels.max()) + 1
-  total_edges = sum(e.shape[1] for e in edges.values())
   mll.event('global_batch_size',
             args.batch_size * args.num_devices)
   mll.event('train_samples', int(train_idx.shape[0]))
   mll.event('eval_samples', int(val_idx.shape[0]))
-  print(f'{total_edges} directed edges over '
-        f'{ {t: int(n) for t, n in counts.items()} }')
-
-  # reversed relations make authors/institutes reachable from paper
-  # seeds (the reference inserts reverse edge types the same way)
   fanout = [int(x) for x in args.fanout.split(',')]
-  rev = {}
-  for (s, r, d), ei in list(edges.items()):
-    if s != d:
-      rev[(d, f'rev_{r}', s)] = ei[::-1].copy()
-  edges.update(rev)
+  if multihost:
+    # edge payloads stay on disk; the model/fanout only need the etype
+    # NAMES, which the partition META records (incl. reversed types)
+    from glt_tpu.partition import load_meta as load_part_meta
+    etypes = [tuple(e) for e in
+              load_part_meta(args.part_root)['edge_types']]
+    print(f'{len(etypes)} edge types over '
+          f'{ {t: int(n) for t, n in counts.items()} }')
+  else:
+    total_edges = sum(e.shape[1] for e in edges.values())
+    print(f'{total_edges} directed edges over '
+          f'{ {t: int(n) for t, n in counts.items()} }')
+    # reversed relations make authors/institutes reachable from paper
+    # seeds (the reference inserts reverse edge types the same way)
+    rev = {}
+    for (s, r, d), ei in list(edges.items()):
+      if s != d:
+        rev[(d, f'rev_{r}', s)] = ei[::-1].copy()
+    edges.update(rev)
+    etypes = list(edges)
 
   part_root = args.part_root or tempfile.mkdtemp(prefix='igbh_parts_')
   have_parts = os.path.exists(os.path.join(part_root, 'META.json'))
@@ -211,13 +225,13 @@ def main():
               for t in counts}
   label_dict = {'paper': labels}
 
-  model = RGNN(edge_types=[reverse_edge_type(e) for e in edges],
+  model = RGNN(edge_types=[reverse_edge_type(e) for e in etypes],
                hidden_features=args.hidden, out_features=num_classes,
                num_layers=len(fanout), conv=args.conv)
   tx = optax.adam(2e-3)
   step = DistHeteroTrainStep(
       dg, dfeats, model, tx, label_dict,
-      {e: fanout for e in edges},
+      {e: fanout for e in etypes},
       batch_size_per_device=args.batch_size, seed_type='paper', seed=0)
   params = step.init_params(jax.random.key(0))
   opt = tx.init(params)

@@ -185,6 +185,49 @@ def _pad_block(topo, local_of, max_rows: int, max_edges: int):
   return ip, ind, eid, w, local_of
 
 
+def _assemble_multihost_store(mesh, axis: str, mine, blocks,
+                              num_rows_global: int, max_rows: int,
+                              max_edges: int, max_degree: int,
+                              has_weights: bool, node_pb, n_parts: int,
+                              edge_dir: str = 'out') -> 'DistGraph':
+  """Shared multihost store assembly (homo builder + one hetero etype):
+  pad this process's blocks to the GLOBALLY-AGREED maxima and
+  contribute them to the collective sharded stacks. Every process must
+  call this with identical maxima/has_weights (agree them with an
+  allgather first) — mismatched participation in
+  make_array_from_process_local_data hangs the job, which is why this
+  code must not be duplicated per builder."""
+  import jax
+  from ..parallel.multihost import global_from_local
+  ips, inds, eids_l, locals_l, weights_l = [], [], [], [], []
+  for p in mine:
+    topo, local_of = blocks[p]
+    ip, ind, eid, w, lo = _pad_block(topo, local_of, max_rows, max_edges)
+    ips.append(ip)
+    inds.append(ind)
+    eids_l.append(eid)
+    locals_l.append(lo)
+    if has_weights:
+      weights_l.append(w)
+  store = DistGraph.__new__(DistGraph)
+  store._finish_init(mesh, axis, num_rows_global, edge_dir, n_parts,
+                     max_rows, max_edges, max_degree)
+  store.indptr = global_from_local(
+      mesh, _stack_or_empty(ips, max_rows + 1, np.int32), axis)
+  store.indices = global_from_local(
+      mesh, _stack_or_empty(inds, max_edges, np.int32), axis)
+  store.edge_ids = global_from_local(
+      mesh, _stack_or_empty(eids_l, max_edges, np.int64), axis)
+  store.edge_weights = (global_from_local(
+      mesh, _stack_or_empty(weights_l, max_edges, np.float32), axis)
+      if has_weights else None)
+  store.local_row = global_from_local(
+      mesh, _stack_or_empty(locals_l, num_rows_global, np.int32), axis)
+  store.node_pb = jax.device_put(
+      _pb_dense(node_pb, num_rows_global), NamedSharding(mesh, P()))
+  return store
+
+
 def dist_graph_from_partitions_multihost(mesh, root_dir: str,
                                          edge_dir: str = 'out',
                                          axis: str = 'data') -> DistGraph:
@@ -197,7 +240,6 @@ def dist_graph_from_partitions_multihost(mesh, root_dir: str,
   Requires jax.distributed to be initialized when process_count > 1.
   """
   import jax
-  from ..parallel.multihost import global_from_local
   from ..partition import load_meta, load_partition
   meta = load_meta(root_dir)
   need = 'by_src' if edge_dir == 'out' else 'by_dst'
@@ -250,34 +292,8 @@ def dist_graph_from_partitions_multihost(mesh, root_dir: str,
   else:
     gmax = local_max
     has_weights = bool(parts_raw) and bool(local_has_w)
-  max_rows = max(int(gmax[0]), 1)
-  max_edges = max(int(gmax[1]), 1)
-
-  ips, inds, eids_l, locals_l, weights_l = [], [], [], [], []
-  for p in mine:
-    topo, local_of = blocks[p]
-    ip, ind, eid, w, lo = _pad_block(topo, local_of, max_rows, max_edges)
-    ips.append(ip)
-    inds.append(ind)
-    eids_l.append(eid)
-    locals_l.append(lo)
-    if has_weights:
-      weights_l.append(w)
-
-  store = DistGraph.__new__(DistGraph)
-  store._finish_init(mesh, axis, num_nodes, edge_dir, n_parts,
-                     max_rows, max_edges, max(int(gmax[2]), 1))
-  store.indptr = global_from_local(
-      mesh, _stack_or_empty(ips, max_rows + 1, np.int32), axis)
-  store.indices = global_from_local(
-      mesh, _stack_or_empty(inds, max_edges, np.int32), axis)
-  store.edge_ids = global_from_local(
-      mesh, _stack_or_empty(eids_l, max_edges, np.int64), axis)
-  store.edge_weights = (global_from_local(
-      mesh, _stack_or_empty(weights_l, max_edges, np.float32), axis)
-      if has_weights else None)
-  store.local_row = global_from_local(
-      mesh, _stack_or_empty(locals_l, num_nodes, np.int32), axis)
-  store.node_pb = jax.device_put(
-      _pb_dense(node_pb, num_nodes), NamedSharding(mesh, P()))
-  return store
+  return _assemble_multihost_store(
+      mesh, axis, mine, blocks, num_nodes,
+      max_rows=max(int(gmax[0]), 1), max_edges=max(int(gmax[1]), 1),
+      max_degree=max(int(gmax[2]), 1), has_weights=has_weights,
+      node_pb=node_pb, n_parts=n_parts, edge_dir=edge_dir)

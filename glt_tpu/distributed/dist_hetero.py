@@ -167,11 +167,9 @@ def dist_hetero_graph_from_partitions_multihost(
   allgather so every process lowers the identical SPMD program.
   """
   import jax
-  from ..parallel.multihost import global_from_local
   from ..partition import load_meta, load_partition
   from .dist_graph import (
-      DistGraph, _build_partition_block, _pad_block, _pb_dense,
-      _stack_or_empty,
+      _assemble_multihost_store, _build_partition_block,
   )
   meta = load_meta(root_dir)
   assert meta['data_cls'] == 'hetero'
@@ -242,39 +240,15 @@ def dist_hetero_graph_from_partitions_multihost(
   for i, e in enumerate(etypes):
     src_t, _, dst_t = e
     row_t = src_t if edge_dir == 'out' else dst_t
-    max_rows = max(int(stats[i, 0]), 1)
-    max_edges = max(int(stats[i, 1]), 1)
-    has_w = bool(stats[i, 3])
-    ips, inds, eids_l, locals_l, weights_l = [], [], [], [], []
-    for p in mine:
-      topo, local_of = blocks[e][p]
-      ip, ind, eid, w, lo = _pad_block(topo, local_of, max_rows,
-                                       max_edges)
-      ips.append(ip)
-      inds.append(ind)
-      eids_l.append(eid)
-      locals_l.append(lo)
-      if has_w:
-        weights_l.append(w)
-    store = DistGraph.__new__(DistGraph)
-    store._finish_init(mesh, axis, node_counts[row_t], 'out', n_parts,
-                       max_rows, max_edges, max(int(stats[i, 2]), 1))
-    store.indptr = global_from_local(
-        mesh, _stack_or_empty(ips, max_rows + 1, np.int32), axis)
-    store.indices = global_from_local(
-        mesh, _stack_or_empty(inds, max_edges, np.int32), axis)
-    store.edge_ids = global_from_local(
-        mesh, _stack_or_empty(eids_l, max_edges, np.int64), axis)
-    store.edge_weights = (global_from_local(
-        mesh, _stack_or_empty(weights_l, max_edges, np.float32), axis)
-        if has_w else None)
-    store.local_row = global_from_local(
-        mesh, _stack_or_empty(locals_l, node_counts[row_t], np.int32),
-        axis)
-    store.node_pb = jax.device_put(
-        _pb_dense(node_pbs[row_t], node_counts[row_t]),
-        NamedSharding(mesh, P()))
-    out.graphs[e] = store
+    # per-etype stores are always pre-oriented, hence edge_dir='out'
+    # (same convention as _build_etype_store)
+    out.graphs[e] = _assemble_multihost_store(
+        mesh, axis, mine, blocks[e], node_counts[row_t],
+        max_rows=max(int(stats[i, 0]), 1),
+        max_edges=max(int(stats[i, 1]), 1),
+        max_degree=max(int(stats[i, 2]), 1),
+        has_weights=bool(stats[i, 3]), node_pb=node_pbs[row_t],
+        n_parts=n_parts, edge_dir='out')
   return out
 
 
