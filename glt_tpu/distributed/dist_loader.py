@@ -36,6 +36,21 @@ class DistNeighborLoader:
       arrays (each device iterates its own partition's training ids,
       exactly like the reference's per-rank seed splits).
     batch_size: per-device batch size.
+
+  bucket_cap sizing (pass to the DistFeature builder): measured on the
+  8-device mesh (benchmarks/bench_bucket_drain.py, committed grid in
+  benchmarks/results/bench_bucket_drain_cpu.json), capped request
+  buckets beat the uncapped [P, B] exchange at EVERY tested skew —
+  smaller messages outweigh extra drain rounds:
+
+    * near-uniform ids: ``bucket_cap = 2 * ceil(B / P)`` — 1 round,
+      ~6x faster than uncapped at 1/4 the bytes per round;
+    * zipf-skewed / adversarial ids: ``4 * ceil(B / P)`` — 2 rounds,
+      still ~1.5x faster than uncapped.
+
+  Default stays uncapped (0) until the TPU wall-times confirm the
+  virtual-mesh ordering; drain ROUND counts are exact either way (the
+  host replay is deterministic).
   """
 
   def __init__(self, dist_graph: DistGraph,
