@@ -6,13 +6,18 @@ program, so this times its separable sub-programs at identical shapes:
 
   * sample   — DistHeteroNeighborSampler.sample_from_nodes alone
                (hetero hop loops + dedup + collective exchanges);
-  * model    — RGNN forward+backward on a dummy batch of the same
-               static budgets (pure MXU/VPU work, no sampling);
-  * train    — the full fused step (sample + feature all_to_all +
-               fwd/bwd + grad pmean);
-  * feature+assembly is the remainder: train - sample - model (the
-    collate all_to_alls, label gather, and fusion overlap — reported
-    as ``residual_ms``; can be negative if XLA overlaps stages).
+  * eval     — eval_step: sample + feature all_to_all + batch assembly
+               + model FORWARD (no backward/optimizer);
+  * train    — the full fused step (adds backward + grad pmean + adam).
+
+Decomposition: assembly+forward = eval - sample;
+backward+optimizer = train - eval. (A dummy-batch model-only timing
+overestimates badly — the fused path trims per-hop — so the model cost
+is bounded between the two differences, not measured standalone.)
+Every stage is synced to the host each iteration — eval_step blocks on
+a scalar transfer internally, so the other stages must block too or
+the differences absorb the dispatch-pipelining gap and bwd_opt can go
+negative.
 
 Prints one JSON line; the seeds/s of the fused step should reproduce
 the r3 number at --papers 4000000 and the stage shares say what to fix.
@@ -38,12 +43,11 @@ _CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(
 def timed(fn, iters, warmup, sync):
   import jax
   for _ in range(warmup):
-    out = fn()
-  jax.block_until_ready(sync(out))
+    jax.block_until_ready(sync(fn()))
   t0 = time.time()
   for _ in range(iters):
     out = fn()
-  jax.block_until_ready(sync(out))
+    jax.block_until_ready(sync(out))
   return (time.time() - t0) / iters * 1e3, out
 
 
@@ -60,6 +64,10 @@ def main():
   ap.add_argument('--cpu-mesh', action=argparse.BooleanOptionalAction,
                   default=True)
   ap.add_argument('--trace', default=None)
+  ap.add_argument('--data-root', default=None,
+                  help='reuse an existing synthesized tree')
+  ap.add_argument('--part-root', default=None,
+                  help='reuse an existing partition dir')
   args = ap.parse_args()
 
   if args.cpu_mesh:
@@ -83,11 +91,17 @@ def main():
   from split_seeds import split_seeds
   from dist_train_rgnn import load_igbh_root
 
-  root = tempfile.mkdtemp(prefix='igbh_prof_')
-  print(f'synthesizing at {args.papers} papers...', file=sys.stderr)
-  synthesize(root, args.papers)
-  compress(root, layout='CSC', bf16=True, topology=False)
-  split_seeds(root)
+  root = args.data_root
+  if root is not None and not os.path.exists(
+      os.path.join(root, 'processed', 'meta.txt')):
+    ap.error(f'--data-root {root} has no processed/meta.txt — refusing '
+             'to silently re-synthesize into it')
+  if root is None:
+    root = tempfile.mkdtemp(prefix='igbh_prof_')
+    print(f'synthesizing at {args.papers} papers...', file=sys.stderr)
+    synthesize(root, args.papers)
+    compress(root, layout='CSC', bf16=True, topology=False)
+    split_seeds(root)
   counts, edges, feats, labels, train_idx, _ = load_igbh_root(root)
   num_classes = int(labels.max()) + 1
   fanout = [int(x) for x in args.fanout.split(',')]
@@ -98,13 +112,24 @@ def main():
   edges.update(rev)
   total_edges = sum(e.shape[1] for e in edges.values())
 
-  part_root = tempfile.mkdtemp(prefix='igbh_prof_parts_')
-  part_feats = {t: np.asarray(f, dtype=np.float32)
-                for t, f in feats.items()}
-  RandomPartitioner(part_root, num_parts=args.num_devices,
-                    num_nodes=dict(counts), edge_index=edges,
-                    node_feat=part_feats).partition()
-  del part_feats
+  part_root = args.part_root
+  if part_root is not None:
+    if not os.path.exists(os.path.join(part_root, 'META.json')):
+      ap.error(f'--part-root {part_root} has no META.json — refusing '
+               'to silently re-partition into it')
+    from glt_tpu.partition.base import load_meta
+    meta_parts = load_meta(part_root)['num_parts']
+    if meta_parts != args.num_devices:
+      ap.error(f'--part-root was partitioned with num_parts='
+               f'{meta_parts} but --num-devices={args.num_devices}')
+  else:
+    part_root = tempfile.mkdtemp(prefix='igbh_prof_parts_')
+    part_feats = {t: np.asarray(f, dtype=np.float32)
+                  for t, f in feats.items()}
+    RandomPartitioner(part_root, num_parts=args.num_devices,
+                      num_nodes=dict(counts), edge_index=edges,
+                      node_feat=part_feats).partition()
+    del part_feats
 
   mesh = make_mesh(args.num_devices)
   dg = DistHeteroGraph.from_dataset_partitions(mesh, part_root)
@@ -136,15 +161,10 @@ def main():
       args.iters, args.warmup,
       lambda o: jax.tree.leaves(o)[:1])
 
-  # --- stage: model fwd+bwd only on a same-budget dummy batch ---------
-  dummy = step.dummy_batch()
-
-  def model_loss(p):
-    out = model.apply(p, dummy)
-    return (out ** 2).mean()
-  grad_fn = jax.jit(jax.value_and_grad(model_loss))
-  ms_model, _ = timed(lambda: grad_fn(params), args.iters, args.warmup,
-                      lambda o: o[0])
+  # --- stage: eval step = sample + gather + assemble + model FORWARD --
+  def eval_only():
+    return step.eval_step(params, seeds, nv, jax.random.key(2))
+  ms_eval, _ = timed(eval_only, args.iters, args.warmup, lambda o: o[0])
 
   # --- full fused train step ------------------------------------------
   state = {'p': params, 'o': opt}
@@ -164,27 +184,24 @@ def main():
     print(f'# trace written to {args.trace}', file=sys.stderr)
 
   seeds_per_s = n_dev * bs / (ms_train / 1e3)
-  # ms_model times ONE device's dummy batch; the SPMD step runs that
-  # per device — on the single-core virtual mesh the devices execute
-  # serially, so the comparable model cost is ms_model * n_dev
-  # (on a real slice they are parallel and ms_model is the number).
-  model_total = ms_model * (n_dev if args.cpu_mesh else 1)
-  residual = ms_train - ms_sample - model_total
+  assembly_fwd = ms_eval - ms_sample
+  bwd_opt = ms_train - ms_eval
   print(json.dumps({
       'metric': 'igbh_step_breakdown',
       'value': round(seeds_per_s, 1),
       'unit': 'seeds/s',
       'vs_baseline': None,
       'detail': {
-          'papers': args.papers, 'total_edges': total_edges,
+          'papers': int(counts['paper']), 'total_edges': total_edges,
           'batch_global': n_dev * bs,
           'ms_train_step': round(ms_train, 1),
+          'ms_eval_step': round(ms_eval, 1),
           'ms_sample_only': round(ms_sample, 1),
-          'ms_model_fwd_bwd_1dev': round(ms_model, 1),
-          'ms_model_fwd_bwd_total': round(model_total, 1),
-          'ms_residual_feature_assembly': round(residual, 1),
+          'ms_assembly_plus_forward': round(assembly_fwd, 1),
+          'ms_backward_plus_optimizer': round(bwd_opt, 1),
           'share_sample': round(ms_sample / ms_train, 3),
-          'share_model': round(model_total / ms_train, 3),
+          'share_assembly_fwd': round(assembly_fwd / ms_train, 3),
+          'share_bwd_opt': round(bwd_opt / ms_train, 3),
           'backend': jax.devices()[0].platform},
   }))
 
