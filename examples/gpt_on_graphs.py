@@ -27,6 +27,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(
     os.path.abspath(__file__)), '..'))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+import common  # noqa: F401  — honors GLT_PLATFORM=cpu before backend init
+
 import numpy as np
 
 from glt_tpu.data import Dataset

@@ -103,3 +103,12 @@ def test_hgt_mag_example():
 def test_pai_table_train_example():
   out = _run('pai_table_train.py', '--epochs', '1', timeout=300)
   assert 'loss=' in out
+
+
+def test_gpt_on_graphs_example():
+  """Ego-subgraph -> LLM prompt demo (reference examples/gpt/arxiv.py):
+  prompts carry the sampled structure and the seed-pair question."""
+  out = _run('gpt_on_graphs.py', '--papers', '300',
+             '--num-batches', '1', timeout=300)
+  assert 'Papers:' in out and 'Known citations' in out
+  assert 'Question: based only on the structure above' in out
