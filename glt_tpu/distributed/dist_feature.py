@@ -54,7 +54,8 @@ class DistFeature:
                num_ids: int, axis: str = 'data', dtype=None,
                row_gather=None, split_ratio: float = 1.0,
                hot_counts: Optional[Sequence[int]] = None,
-               cold_fetcher=None, bucket_cap: int = 0):
+               cold_fetcher=None, bucket_cap: int = 0,
+               host_offload: Optional[bool] = None):
     n_parts = len(parts)
     assert mesh.shape[axis] == n_parts
     rows_max = max(max(f.shape[0] for f, _ in parts), 1)
@@ -101,6 +102,32 @@ class DistFeature:
     self.array = jax.device_put(np.stack(feats_l), shard)  # [P, Rh, D]
     self.id2index = jax.device_put(np.stack(maps_l), shard)  # [P, N]
     self.feat_pb = jax.device_put(np.stack(pbs_l), shard)    # [P, N]
+    # Host-offload (reference unified_tensor.cu:202-231 UVA analog, see
+    # parallel.ShardedFeature): the cold blocks become one stacked
+    # pinned-host array gathered INSIDE the compiled program, so fused
+    # SPMD train steps can consume spilled stores and lookup() needs no
+    # host phase. Default on when spilling (GLT_HOST_OFFLOAD=0 or
+    # host_offload=False opt out).
+    import os
+    requested = host_offload
+    if host_offload is None:
+      host_offload = (self._spill
+                      and os.environ.get('GLT_HOST_OFFLOAD', '1') != '0')
+    if host_offload and self._spill and self._host_cold:
+      c_max = max(c.shape[0] for c in self._host_cold.values())
+      np_dtype = np.dtype(self.array.dtype)
+      stack = np.zeros((n_parts, c_max, self.feature_dim), np_dtype)
+      for p, c in self._host_cold.items():
+        stack[p, :c.shape[0]] = c
+      try:
+        self.cold_array = jax.device_put(
+            stack, NamedSharding(mesh, P(axis),
+                                 memory_kind='pinned_host'))
+      except Exception:
+        if requested:  # explicitly asked for: do not mask the failure
+          raise
+        self.cold_array = None  # no memory kinds: keep the host phase
+      self._build_lookup_fn()
 
   def _finish_init(self, mesh: Mesh, axis: str, num_ids: int,
                    feat_dim: int, rows_max: int, n_parts: int,
@@ -146,25 +173,52 @@ class DistFeature:
     # actually traced and refuse mismatched lookups (see lookup())
     self._traced_cap = None
     self._hot_counts_dev = jnp.asarray(self.hot_counts)
-    # compiled once; rebuilding shard_map per call would re-trace
+    # stacked pinned-host cold blocks [P, C_max, D]; builders that
+    # host-offload set this after assembling the arrays and rebuild
+    self.cold_array = None
+    self._build_lookup_fn()
+
+  def _call_lookup_fn(self, ids, valid):
+    """Dispatch to the compiled lookup with the operand list matching
+    the _build_lookup_fn variant in effect."""
+    if self.cold_array is not None:
+      return self._lookup_fn(self.array, self.id2index, self.feat_pb,
+                             self.cold_array, ids, valid)
+    return self._lookup_fn(self.array, self.id2index, self.feat_pb,
+                           ids, valid)
+
+  def _build_lookup_fn(self):
+    """(Re)build the compiled whole-mesh lookup. Compiled once per
+    build; rebuilding shard_map per call would re-trace."""
+    sp = P(self.axis)
+    if self.cold_array is not None:
+      # offloaded: cold lanes are served in-program — single output
+      self._lookup_fn = jax.jit(jax.shard_map(
+          lambda f, m, pb, c, i, v: self.lookup_local(
+              f[0], m[0], pb[0], i, v, cold_shard=c[0]),
+          mesh=self.mesh, in_specs=(sp,) * 6, out_specs=sp,
+          check_vma=False))
+      return
     self._lookup_fn = jax.jit(jax.shard_map(
         lambda f, m, pb, i, v: self.lookup_local(f[0], m[0], pb[0], i, v),
         mesh=self.mesh,
-        in_specs=(P(self.axis), P(self.axis), P(self.axis), P(self.axis),
-                  P(self.axis)),
-        out_specs=(P(self.axis) if not self._spill
-                   else (P(self.axis), P(self.axis))), check_vma=False))
+        in_specs=(sp, sp, sp, sp, sp),
+        out_specs=(sp if not self._spill else (sp, sp)),
+        check_vma=False))
 
   # -- in-shard lookup (call inside shard_map) ---------------------------
 
   def lookup_local(self, feat_shard, map_shard, pb, ids, valid,
-                   axis_name: Optional[str] = None):
+                   axis_name: Optional[str] = None, cold_shard=None):
     """feat_shard: [Rh, D] hot block; map_shard: [N]; pb: [N] — THIS
     device's routing book; ids/valid: [B]. Returns [B, D] (zeros where
-    invalid). With host spill active, returns ([B, D], cold_flag [B]):
-    flagged lanes are valid ids whose row lives in the owner's host
-    shard — served as zeros here and resolved by lookup()'s host
-    phase."""
+    invalid). With host spill active and no ``cold_shard``, returns
+    ([B, D], cold_flag [B]): flagged lanes are valid ids whose row
+    lives in the owner's host shard — served as zeros here and resolved
+    by lookup()'s host phase. With ``cold_shard`` (this device's
+    pinned-host [C_max, D] block), cold lanes are instead served
+    in-program by a compute_on('device_host') gather and the return is
+    the plain [B, D] — the form fused train steps consume."""
     ax = axis_name or self.axis
     n = self.num_partitions
     owner = jnp.take(pb, jnp.clip(ids, 0, self.num_ids - 1), mode='clip')
@@ -191,6 +245,21 @@ class DistFeature:
       rows_out = jnp.take(feat_shard, safe_rows, axis=0)
     served = jnp.where(ok[:, None], rows_out, 0)
     if not self._spill:
+      resp = all_to_all(served.reshape(n, -1, self.feature_dim), ax)
+      return unbucket(resp, meta, n)
+    if cold_shard is not None:
+      # serve the owner's spilled rows from pinned host memory without
+      # leaving the program: index arithmetic stays on device, the
+      # gather runs host-side (raw indexing — bounds ops would
+      # materialize device-space constants inside the host region)
+      from jax.experimental import compute_on
+      cold_idx = jnp.clip(rows - my_hot, 0, cold_shard.shape[0] - 1)
+      idx_h = jax.device_put(cold_idx, jax.memory.Space.Host)
+      with compute_on.compute_on('device_host'):
+        cold_out = cold_shard[idx_h]
+      cold_out = jax.device_put(cold_out, jax.memory.Space.Device)
+      served = jnp.where(cold[:, None], cold_out.astype(served.dtype),
+                         served)
       resp = all_to_all(served.reshape(n, -1, self.feature_dim), ax)
       return unbucket(resp, meta, n)
     # ride the cold flag back as one extra response column so the
@@ -230,10 +299,10 @@ class DistFeature:
     pending = valid_np
     out = None
     cold_lanes = []
+    offloaded = self.cold_array is not None
     while True:
-      res = self._lookup_fn(self.array, self.id2index, self.feat_pb,
-                            ids, jnp.asarray(pending))
-      if self._spill:
+      res = self._call_lookup_fn(ids, jnp.asarray(pending))
+      if self._spill and not offloaded:
         r, flag = res
         cold_lanes.append(_flag_lanes(flag))
       else:
@@ -358,7 +427,8 @@ class DistFeature:
                          axis: str = 'data', dtype=None,
                          kind: str = 'node', row_gather=None,
                          cold_fetcher=None, split_ratio=None,
-                         bucket_cap: int = 0):
+                         bucket_cap: int = 0,
+                         host_offload: Optional[bool] = None):
     """Single-host simulation: build from every partition's DistDataset.
     Each partition Feature's own hot/cold split carries over: its cold
     rows become this store's host shard for that partition (beyond-HBM
@@ -399,7 +469,8 @@ class DistFeature:
       parts.append((block, feat._id2index))
     return cls(mesh, parts, pbs, num_ids, axis=axis, dtype=dtype,
                row_gather=row_gather, hot_counts=hots,
-               cold_fetcher=cold_fetcher, bucket_cap=bucket_cap)
+               cold_fetcher=cold_fetcher, bucket_cap=bucket_cap,
+               host_offload=host_offload)
 
 
 def dist_feature_from_partitions_multihost(mesh, root_dir: str,
@@ -409,7 +480,8 @@ def dist_feature_from_partitions_multihost(mesh, root_dir: str,
                                            row_gather=None,
                                            split_ratio: float = 1.0,
                                            cold_fetcher=None,
-                                           bucket_cap: int = 0
+                                           bucket_cap: int = 0,
+                                           host_offload=None
                                            ) -> DistFeature:
   """Multi-host DistFeature: each process loads ONLY its partitions'
   feature blocks (cache-concat + PB rewrite included) and contributes
@@ -417,8 +489,12 @@ def dist_feature_from_partitions_multihost(mesh, root_dir: str,
   Counterpart of dist_graph_from_partitions_multihost.
 
   ``split_ratio < 1`` spills each partition's cold tail to its OWN
-  process's host RAM (beyond-HBM features); cross-process cold lookups
-  then need a ``cold_fetcher`` wired to the rpc fabric (see
+  process's host RAM (beyond-HBM features). By default (host_offload
+  auto) the cold tails become a pinned-host sharded array served
+  in-program — each partition's cold rows live in its OWN process's
+  host RAM and are gathered by its own device, so no cross-process
+  fetch exists at all. With ``host_offload=False`` cross-process cold
+  lookups instead need a ``cold_fetcher`` wired to the rpc fabric (see
   DistFeature.set_cold_fetcher / cold_get).
 
   ``kind='edge'`` builds the edge-feature store from the partitions'
@@ -530,4 +606,35 @@ def dist_feature_from_partitions_multihost(mesh, root_dir: str,
       mesh, stack_or_empty(maps_l, (num_ids,), np.int32), axis)
   store.feat_pb = global_from_local(
       mesh, stack_or_empty(pbs_l, (num_ids,), np.int32), axis)
+  import os
+  if host_offload is None:
+    offload = spill and os.environ.get('GLT_HOST_OFFLOAD', '1') != '0'
+  else:
+    offload = bool(host_offload)
+  if offload and spill:
+    # global cold capacity must be agreed (it is baked into every
+    # process's trace); partitions are disjoint, so max-allgather
+    local_cmax = max((c.shape[0] for c in store._host_cold.values()),
+                     default=0)
+    if jax.process_count() > 1:
+      from jax.experimental import multihost_utils
+      c_max = int(np.asarray(multihost_utils.process_allgather(
+          jnp.asarray([local_cmax]))).max())
+    else:
+      c_max = local_cmax
+    if c_max:
+      np_dtype = np.dtype(store.array.dtype)
+      local_stack = np.zeros((len(mine), c_max, feat_dim), np_dtype)
+      for i, p in enumerate(mine):
+        c = store._host_cold.get(p)
+        if c is not None:
+          local_stack[i, :c.shape[0]] = c
+      try:
+        store.cold_array = global_from_local(
+            mesh, local_stack, axis, memory_kind='pinned_host')
+      except Exception:
+        if host_offload:  # explicitly requested: do not mask the error
+          raise
+        store.cold_array = None
+      store._build_lookup_fn()
   return store

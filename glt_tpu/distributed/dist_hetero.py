@@ -617,7 +617,8 @@ class DistHeteroTrainStep:
                  < out['node_count'][t])
         x_dict[t] = feats[t].lookup_local(
             fs['array'][0], fs['id2index'][0], fs['feat_pb'][0],
-            jnp.maximum(out['node'][t], 0), valid, axis_name=axis)
+            jnp.maximum(out['node'][t], 0), valid, axis_name=axis,
+            cold_shard=fs['cold'][0] if 'cold' in fs else None)
       y = jnp.take(labels[seed_type],
                    jnp.maximum(out['batch'], 0)[:bs])
       fk = self._final_key
@@ -629,7 +630,8 @@ class DistHeteroTrainStep:
           edge_attr_dict[fk(e)] = efeats[e].lookup_local(
               fs['array'][0], fs['id2index'][0], fs['feat_pb'][0],
               jnp.maximum(out['edge'][e], 0), out['edge_mask'][e],
-              axis_name=axis)
+              axis_name=axis,
+              cold_shard=fs['cold'][0] if 'cold' in fs else None)
       batch = HeteroBatch(
           x_dict=x_dict,
           row_dict={fk(e): out['col'][e] for e in etypes},
@@ -651,12 +653,15 @@ class DistHeteroTrainStep:
       if g.graphs[e].edge_weights is not None:
         d['edge_weights'] = sp
       return d
+    def store_spec(st):
+      d = dict(array=sp, id2index=sp, feat_pb=sp)
+      if st.cold_array is not None:  # pinned-host offloaded cold block
+        d['cold'] = sp
+      return d
     specs = dict(
         shards={e: etype_spec(e) for e in etypes},
-        feats={t: dict(array=sp, id2index=sp, feat_pb=sp)
-               for t in types},
-        efeats={e: dict(array=sp, id2index=sp, feat_pb=sp)
-                for e in efeats},
+        feats={t: store_spec(feats[t]) for t in types},
+        efeats={e: store_spec(efeats[e]) for e in efeats},
         tables={t: (sp, sp) for t in types},
         labels={t: P() for t in self.labels},
         sp=sp)
@@ -670,12 +675,16 @@ class DistHeteroTrainStep:
         if g.graphs[e].edge_weights is not None:
           d['edge_weights'] = g.graphs[e].edge_weights
         return d
+      def store_payload(st):
+        d = dict(array=st.array, id2index=st.id2index,
+                 feat_pb=st.feat_pb)
+        if st.cold_array is not None:
+          d['cold'] = st.cold_array
+        return d
       return (
           {e: etype_payload(e) for e in etypes},
-          {t: dict(array=feats[t].array, id2index=feats[t].id2index,
-                   feat_pb=feats[t].feat_pb) for t in types},
-          {e: dict(array=efeats[e].array, id2index=efeats[e].id2index,
-                   feat_pb=efeats[e].feat_pb) for e in efeats})
+          {t: store_payload(feats[t]) for t in types},
+          {e: store_payload(efeats[e]) for e in efeats})
 
     return device_batch, specs, payloads
 

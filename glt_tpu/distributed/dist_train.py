@@ -100,10 +100,17 @@ class DistTrainStep:
     n_parts = g.num_partitions
     with_edge = ef is not None
 
+    f_off = f.cold_array is not None
+    ef_off = ef is not None and ef.cold_array is not None
+
     def device_step(params, opt_state, indptr, indices, geids, local_row,
                     node_pb, feats, id2index, feat_pb, labels, seeds,
-                    n_valid, key, table, scratch, *eargs):
-      efeats, eid2index, efeat_pb = eargs if with_edge else (None,) * 3
+                    n_valid, key, table, scratch, *rest):
+      rest = list(rest)
+      fcold = rest.pop(0) if f_off else None
+      efeats, eid2index, efeat_pb = \
+          (rest[:3] if with_edge else (None,) * 3)
+      efcold = rest[3] if ef_off else None
       shards = dict(indptr=indptr[0], indices=indices[0],
                     edge_ids=geids[0], local_row=local_row[0],
                     node_pb=node_pb)
@@ -116,7 +123,8 @@ class DistTrainStep:
       node_valid = jnp.arange(out['node'].shape[0]) < out['node_count']
       x = f.lookup_local(feats[0], id2index[0], feat_pb[0],
                          jnp.maximum(out['node'], 0), node_valid,
-                         axis_name=axis)
+                         axis_name=axis,
+                         cold_shard=fcold[0] if f_off else None)
       edge_attr = None
       if with_edge:
         # the efeat collate of the reference loop, as one more
@@ -124,7 +132,8 @@ class DistTrainStep:
         edge_attr = ef.lookup_local(
             efeats[0], eid2index[0], efeat_pb[0],
             jnp.maximum(out['edge'], 0), out['edge_mask'],
-            axis_name=axis)
+            axis_name=axis,
+            cold_shard=efcold[0] if ef_off else None)
       y = jnp.take(labels, jnp.maximum(out['batch'], 0)[:bs])
       batch = Batch(x=x, row=out['row'], col=out['col'],
                     edge_mask=out['edge_mask'], node=out['node'],
@@ -148,7 +157,9 @@ class DistTrainStep:
       return params, opt_state, table_o[None], scratch_o[None], loss[None]
 
     sp = P(self.axis)
-    extra = (sp, sp, sp) if with_edge else ()
+    extra = ((sp,) if f_off else ()) \
+        + ((sp, sp, sp) if with_edge else ()) \
+        + ((sp,) if ef_off else ())
     fn = jax.shard_map(
         device_step, mesh=self.mesh,
         in_specs=(P(), P(), sp, sp, sp, sp, P(), sp, sp, sp, P(), sp, sp,
@@ -167,7 +178,9 @@ class DistTrainStep:
                 n_valid, keys, tables, scratches, *eargs)
 
     def run(params, opt_state, tables, scratches, seeds, n_valid, keys):
-      eargs = ((ef.array, ef.id2index, ef.feat_pb) if with_edge else ())
+      eargs = ((f.cold_array,) if f_off else ()) \
+          + ((ef.array, ef.id2index, ef.feat_pb) if with_edge else ()) \
+          + ((ef.cold_array,) if ef_off else ())
       return step(params, opt_state, g.indptr, g.indices, g.edge_ids,
                   g.local_row, g.node_pb, f.array, f.id2index,
                   f.feat_pb, self.labels, seeds, n_valid, keys, tables,

@@ -65,13 +65,16 @@ def require_device_resident(store, ctx: str) -> None:
   cold row. Trainers call this up front to fail loudly instead."""
   if store is None:
     return
-  if getattr(store, '_spill', False):
+  if getattr(store, '_spill', False) and \
+      getattr(store, 'cold_array', None) is None:
     raise NotImplementedError(
         f'{ctx}: this train step runs sampling+gather+update as one '
         'jitted SPMD program and cannot resolve host-spilled (cold) '
-        'feature rows; use a device-resident store (split_ratio=1.0) '
-        'or the loader-driven path (DistLoader / NodeLoader collate, '
-        'which resolves cold rows on host between device calls)')
+        'feature rows; use host_offload=True (pinned-host cold block '
+        'served inside the program via compute_on), a device-resident '
+        'store (split_ratio=1.0), or the loader-driven path '
+        '(DistLoader / NodeLoader collate, which resolves cold rows '
+        'on host between device calls)')
   if getattr(store, 'bucket_cap', 0):
     raise NotImplementedError(
         f'{ctx}: bucket_cap relies on lookup()\'s host-side overflow '
@@ -90,7 +93,7 @@ class ShardedFeature:
 
   def __init__(self, feats, mesh: Mesh, axis: str = 'data', dtype=None,
                row_gather=None, split_ratio: float = 1.0,
-               bucket_cap: int = 0):
+               bucket_cap: int = 0, host_offload: Optional[bool] = None):
     # row_gather: optional (shard [R, D], rows [M]) -> [M, D] override
     # for the serving gather — tests inject the interpret-mode Pallas
     # kernel; on TPU GLT_USE_PALLAS=1 selects it automatically
@@ -144,18 +147,51 @@ class ShardedFeature:
       hot = feats
     self.array = jax.device_put(
         hot, NamedSharding(mesh, P(axis)))
+    # Host-offload: the cold block lives in PINNED HOST memory as a jax
+    # array and is gathered INSIDE the compiled program via
+    # compute_on('device_host') — the TPU-native analog of the
+    # reference's UVA zero-copy CPU shard (unified_tensor.cu:202-231:
+    # cudaHostRegisterMapped + device-side GatherTensorKernel reads
+    # across PCIe). This is what lets fused SPMD train steps consume
+    # spilled stores; without it cold rows resolve in lookup()'s host
+    # phase between device calls. Default: on when spilling (opt out
+    # with GLT_HOST_OFFLOAD=0 or host_offload=False).
+    import os
+    requested = host_offload
+    if host_offload is None:
+      host_offload = (self._spill
+                      and os.environ.get('GLT_HOST_OFFLOAD', '1') != '0')
+    self.cold_array = None
+    if host_offload and self._spill:
+      cold = np.concatenate(self._host_cold)
+      try:
+        self.cold_array = jax.device_put(
+            cold, NamedSharding(mesh, P(axis),
+                                memory_kind='pinned_host'))
+      except Exception:
+        if requested:  # explicitly asked for: do not mask the failure
+          raise
+        self.cold_array = None  # platform lacks memory kinds: host phase
     # compiled once; rebuilding shard_map per call would re-trace
-    self._lookup_fn = jax.jit(jax.shard_map(
-        lambda shard, i, v: self.lookup_local(shard, i, v),
-        mesh=self.mesh,
-        in_specs=(P(self.axis), P(self.axis), P(self.axis)),
-        out_specs=P(self.axis), check_vma=False))
+    if self.cold_array is not None:
+      self._lookup_fn = jax.jit(jax.shard_map(
+          lambda shard, cold_shard, i, v: self.lookup_local(
+              shard, i, v, cold_shard=cold_shard),
+          mesh=self.mesh,
+          in_specs=(P(self.axis),) * 4,
+          out_specs=P(self.axis), check_vma=False))
+    else:
+      self._lookup_fn = jax.jit(jax.shard_map(
+          lambda shard, i, v: self.lookup_local(shard, i, v),
+          mesh=self.mesh,
+          in_specs=(P(self.axis), P(self.axis), P(self.axis)),
+          out_specs=P(self.axis), check_vma=False))
 
   # -- in-shard lookup ---------------------------------------------------
 
   def lookup_local(self, local_shard: jax.Array, ids: jax.Array,
-                   valid: jax.Array, axis_name: Optional[str] = None
-                   ) -> jax.Array:
+                   valid: jax.Array, axis_name: Optional[str] = None,
+                   cold_shard: Optional[jax.Array] = None) -> jax.Array:
     """Gather rows for global ``ids`` from inside shard_map.
 
     Args:
@@ -164,6 +200,10 @@ class ShardedFeature:
       ids: [B] global row ids requested by this device.
       valid: [B] mask.
       axis_name: mesh axis to exchange over (defaults to ``self.axis``).
+      cold_shard: this device's pinned-host [cold_count, D] block when
+        host-offloading; cold lanes are then served in-program by a
+        compute_on('device_host') gather instead of lookup()'s host
+        phase. Fused train steps pass ``self.cold_array``'s shard here.
 
     Returns [B, D]; invalid slots are zero.
     """
@@ -212,6 +252,26 @@ class ShardedFeature:
     else:
       rows_out = jnp.take(local_shard, safe_rows, axis=0)
     served = jnp.where(ok[..., None], rows_out, 0)
+    if cold_shard is not None and self._spill:
+      # serve the owner's SPILLED rows from pinned host memory without
+      # leaving the program: index arithmetic stays on device, the
+      # gather itself runs host-side (raw indexing — bounds logic would
+      # materialize device-space constants inside the host region)
+      from jax.experimental import compute_on
+      cold_count = self.rows_per_shard - self.hot_count
+      cold_ok = (local_rows >= self.hot_count) & \
+          (local_rows < self.rows_per_shard) & (req_in >= 0)
+      cold_rows_idx = jnp.clip(local_rows - self.hot_count, 0,
+                               cold_count - 1)
+      idx_h = jax.device_put(cold_rows_idx.reshape(-1),
+                             jax.memory.Space.Host)
+      with compute_on.compute_on('device_host'):
+        cold_out = cold_shard[idx_h]
+      cold_out = jax.device_put(
+          cold_out, jax.memory.Space.Device).reshape(
+              cold_rows_idx.shape + (self.feature_dim,))
+      served = jnp.where(cold_ok[..., None],
+                         cold_out.astype(served.dtype), served)
     # send responses back; row p now holds our requests served by peer p
     resp = jax.lax.all_to_all(served, ax, split_axis=0, concat_axis=0,
                               tiled=False)
@@ -249,12 +309,18 @@ class ShardedFeature:
                                 as_numpy(valid).astype(bool), n_shards,
                                 b)
     else:
-      out = self._lookup_fn(self.array, ids, valid)
-    if not self._spill:
+      out = self._call_lookup_fn(ids, valid)
+    if not self._spill or self.cold_array is not None:
+      # host-offloaded stores serve cold lanes inside the program
       return out
     return self._resolve_cold_sharded(out, ids_np,
                                       as_numpy(valid).astype(bool),
                                       n_shards)
+
+  def _call_lookup_fn(self, ids, valid):
+    if self.cold_array is not None:
+      return self._lookup_fn(self.array, self.cold_array, ids, valid)
+    return self._lookup_fn(self.array, ids, valid)
 
   def _lookup_capped(self, ids, ids_np, valid_np, n_shards, b):
     """Drain overflowed requests through the SAME compiled lookup:
@@ -271,7 +337,7 @@ class ShardedFeature:
     pending = valid_np
     out = None
     while True:
-      out_r = self._lookup_fn(self.array, ids, jnp.asarray(pending))
+      out_r = self._call_lookup_fn(ids, jnp.asarray(pending))
       out = out_r if out is None else out + out_r
       over = overflow_lanes(
           np.where(pending, owner, n_shards), n_shards, b,

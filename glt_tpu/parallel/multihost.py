@@ -60,15 +60,17 @@ def initialize(coordinator_address: Optional[str] = None,
                'running single-process')
 
 
-def global_from_local(mesh: Mesh, local: np.ndarray, axis: str = 'data'):
+def global_from_local(mesh: Mesh, local: np.ndarray, axis: str = 'data',
+                      memory_kind: str | None = None):
   """Build the [n_shards, ...] mesh-sharded stack where this process
   supplies blocks only for its own devices.
 
   ``local``: [n_local_shards, ...] — this process's blocks, ordered by
   its device order along the axis. Single-process: equals a plain
-  device_put of the full stack.
+  device_put of the full stack. ``memory_kind='pinned_host'`` places
+  the shards in host memory (the offloaded cold-block store).
   """
-  sharding = NamedSharding(mesh, P(axis))
+  sharding = NamedSharding(mesh, P(axis), memory_kind=memory_kind)
   if jax.process_count() == 1:
     return jax.device_put(local, sharding)
   n = mesh.shape[axis]

@@ -95,8 +95,10 @@ class SPMDSageTrainStep:
     fanouts, bs = self.fanouts, self.bs
     offs = tuple(edge_hop_offsets(bs, fanouts))
 
+    offloaded = feature.cold_array is not None
+
     def device_step(params, opt_state, table, scratch, seeds, n_valid,
-                    key, feat_shard, labels):
+                    key, feat_shard, labels, *cold_shard):
       table = table[0]
       scratch = scratch[0]
       key = jax.random.fold_in(key[0], jax.lax.axis_index(axis))
@@ -107,7 +109,8 @@ class SPMDSageTrainStep:
       node_valid = jnp.arange(out['node'].shape[0]) < out['node_count']
       x = feature.lookup_local(
           feat_shard, jnp.maximum(out['node'], 0), node_valid,
-          axis_name=axis)
+          axis_name=axis,
+          cold_shard=cold_shard[0] if cold_shard else None)
       y = jnp.take(labels, jnp.maximum(out['batch'], 0)[:bs])
       batch = Batch(
           x=x, row=out['row'], col=out['col'], edge_mask=out['edge_mask'],
@@ -134,14 +137,20 @@ class SPMDSageTrainStep:
     fn = jax.shard_map(
         device_step, mesh=self.mesh,
         in_specs=(P(), P(), P(self.axis), P(self.axis), P(self.axis),
-                  P(self.axis), P(self.axis), P(self.axis), P()),
+                  P(self.axis), P(self.axis), P(self.axis), P())
+        + ((P(self.axis),) if offloaded else ()),
         out_specs=(P(), P(), P(self.axis), P(self.axis), P(self.axis)),
         check_vma=False)
 
     @functools.partial(jax.jit, donate_argnums=(2, 3))
-    def step(params, opt_state, tables, scratches, seeds, n_valid, keys):
+    def step(params, opt_state, tables, scratches, seeds, n_valid, keys,
+             feat_array, *cold):
+      # feat/cold ride as explicit args so their committed shardings —
+      # including the cold block's pinned_host memory kind — are
+      # preserved (a closed-over array would be re-laid-out as a
+      # default-memory constant)
       return fn(params, opt_state, tables, scratches, seeds, n_valid,
-                keys, feature.array, self.labels)
+                keys, feat_array, self.labels, *cold)
 
     return step
 
@@ -155,7 +164,9 @@ class SPMDSageTrainStep:
     n_valid = jax.device_put(
         jnp.asarray(n_valid_per_device, jnp.int32),
         NamedSharding(self.mesh, P(self.axis)))
+    extra = ((self.feature.cold_array,)
+             if self.feature.cold_array is not None else ())
     params, opt_state, self.tables, self.scratches, loss = self._step_fn(
         params, opt_state, self.tables, self.scratches, seeds, n_valid,
-        keys)
+        keys, self.feature.array, *extra)
     return params, opt_state, loss

@@ -63,13 +63,25 @@ def main():
     local = np.asarray(shard.data)
     np.testing.assert_allclose(local[:, 0], eids[shard.index[0]])
 
-  # beyond-HBM spill across PROCESSES: each process keeps its own
-  # partitions' cold rows in host RAM and serves the peer's cold
+  # beyond-HBM spill across PROCESSES, default path: each process's
+  # cold tails become its pinned-host shard of the offloaded cold
+  # array, served in-program — no cross-process fetch at all
+  dfo = dist_feature_from_partitions_multihost(mesh, root,
+                                               split_ratio=0.5)
+  assert dfo.cold_array is not None, 'multihost host-offload inactive'
+  xo = dfo.lookup(jnp.asarray(ids))
+  for shard in xo.addressable_shards:
+    local = np.asarray(shard.data)
+    np.testing.assert_allclose(local[:, 0], ids[shard.index[0]])
+
+  # legacy fetcher path (host_offload=False): each process keeps its
+  # own partitions' cold rows in host RAM and serves the peer's cold
   # lookups over the rpc fabric (reference RpcFeatureLookupCallee,
   # dist_feature.py:57-66)
   from glt_tpu.distributed.rpc import RpcClient, RpcServer
   dfs = dist_feature_from_partitions_multihost(mesh, root,
-                                               split_ratio=0.5)
+                                               split_ratio=0.5,
+                                               host_offload=False)
   my_port, peer_port = int(sys.argv[4 + rank]), int(sys.argv[5 - rank])
   server = RpcServer(port=my_port)
   server.register('cold_get',

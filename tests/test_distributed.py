@@ -975,3 +975,115 @@ def test_dist_feature_bucket_cap_mutation_after_trace_rejected(
   df.bucket_cap = 8
   with pytest.raises(RuntimeError, match='bucket_cap changed'):
     df.lookup(ids)
+
+
+def test_dist_feature_host_offload_active_and_parity(mesh, dist_datasets):
+  # spilled store auto-builds the pinned-host cold block; lookup parity
+  # vs the resident store with NO host phase (cold served in-program)
+  df = DistFeature.from_dist_datasets(mesh, dist_datasets,
+                                      split_ratio=0.4)
+  assert df._spill and df.cold_array is not None
+  assert df.cold_array.sharding.memory_kind == 'pinned_host'
+  rng = np.random.default_rng(31)
+  ids = rng.integers(0, N_NODES, N_PARTS * 16)
+  out = np.asarray(df.lookup(ids))
+  np.testing.assert_allclose(out[:, 0], ids)
+  # explicit opt-out keeps the legacy host-phase path
+  legacy = DistFeature.from_dist_datasets(mesh, dist_datasets,
+                                          split_ratio=0.4,
+                                          host_offload=False)
+  assert legacy._spill and legacy.cold_array is None
+  np.testing.assert_allclose(np.asarray(legacy.lookup(ids)), out)
+
+
+def test_dist_train_step_with_host_offloaded_spill(mesh, part_dir,
+                                                   dist_datasets):
+  # the fused one-program step accepts a spilled store once the cold
+  # block is host-offloaded, and trains IDENTICALLY to resident
+  import optax
+  from glt_tpu.distributed import DistTrainStep
+  from glt_tpu.models import GraphSAGE
+  dg = DistGraph.from_dataset_partitions(mesh, part_dir)
+  labels = (np.arange(N_NODES) % 4).astype(np.int32)
+  model = GraphSAGE(hidden_features=16, out_features=4, num_layers=1)
+  tx = optax.adam(1e-2)
+
+  def losses(df):
+    step = DistTrainStep(dg, df, model, tx, labels, fanouts=[2],
+                         batch_size_per_device=4)
+    params = step.init_params(jax.random.key(0))
+    opt = tx.init(params)
+    out = []
+    for it in range(3):
+      seeds = (np.arange(N_PARTS * 4) * 3) % N_NODES
+      params, opt, loss = step(params, opt, seeds, np.full(N_PARTS, 4),
+                               jax.random.key(it))
+      out.append(float(np.asarray(loss)[0]))
+    return out
+
+  spilled = DistFeature.from_dist_datasets(mesh, dist_datasets,
+                                           split_ratio=0.4)
+  assert spilled.cold_array is not None
+  resident = DistFeature.from_dist_datasets(mesh, dist_datasets)
+  np.testing.assert_allclose(losses(spilled), losses(resident),
+                             rtol=1e-6)
+
+
+def test_dist_hetero_train_step_with_host_offloaded_spill(
+    tmp_path_factory, mesh):
+  # the fused hetero (IGBH-path) step trains spilled per-type stores
+  # via the pinned-host cold blocks, identically to resident stores
+  import optax
+  from glt_tpu.distributed import (
+      DistDataset, DistHeteroGraph, DistHeteroTrainStep,
+  )
+  from glt_tpu.models import RGNN
+  from glt_tpu.typing import reverse_edge_type
+  root = str(tmp_path_factory.mktemp('hetero_spill_train'))
+  u2i = ('user', 'u2i', 'item')
+  i2i = ('item', 'i2i', 'item')
+  nu, ni = 16, 32
+  u = np.arange(nu)
+  u2i_ei = np.stack([np.repeat(u, 2),
+                     np.stack([2*u, 2*u+1], 1).reshape(-1) % ni])
+  i = np.arange(ni)
+  i2i_ei = np.stack([np.repeat(i, 2),
+                     np.stack([(i+1) % ni, (i+2) % ni], 1).reshape(-1)])
+  w = max(nu, ni)
+  feats = {'user': np.pad(np.eye(nu, dtype=np.float32),
+                          ((0, 0), (0, w - nu))),
+           'item': np.pad(np.eye(ni, dtype=np.float32),
+                          ((0, 0), (0, w - ni)))}
+  RandomPartitioner(root, num_parts=N_PARTS,
+                    num_nodes={'user': nu, 'item': ni},
+                    edge_index={u2i: u2i_ei, i2i: i2i_ei},
+                    node_feat=feats).partition()
+  dg = DistHeteroGraph.from_dataset_partitions(mesh, root)
+  dss = [DistDataset().load(root, p) for p in range(N_PARTS)]
+  labels = {'user': (np.arange(nu) % 3).astype(np.int32)}
+  model = RGNN(edge_types=[reverse_edge_type(u2i), i2i],
+               hidden_features=16, out_features=3, num_layers=2,
+               conv='rsage')
+  tx = optax.adam(1e-2)
+
+  def losses(split):
+    dfeats = {t: DistFeature.from_dist_datasets(mesh, dss, ntype=t,
+                                                split_ratio=split)
+              for t in ('user', 'item')}
+    if split is not None and split < 1:
+      assert any(st.cold_array is not None for st in dfeats.values())
+    step = DistHeteroTrainStep(dg, dfeats, model, tx, labels,
+                               {u2i: [2, 2], i2i: [2, 2]},
+                               batch_size_per_device=2,
+                               seed_type='user', seed=0)
+    params = step.init_params(jax.random.key(0))
+    opt = tx.init(params)
+    out = []
+    for it in range(3):
+      seeds = (np.arange(N_PARTS * 2).reshape(N_PARTS, 2) * 5) % nu
+      params, opt, loss = step(params, opt, seeds, np.full(N_PARTS, 2),
+                               jax.random.key(it))
+      out.append(float(np.asarray(loss)[0]))
+    return out
+
+  np.testing.assert_allclose(losses(0.3), losses(None), rtol=1e-6)

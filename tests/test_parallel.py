@@ -197,22 +197,75 @@ def test_sharded_feature_spill_all_cold(mesh):
   np.testing.assert_allclose(out, feats[ids])
 
 
-def test_spill_store_rejected_by_fused_train_step(mesh):
-  # the fused SPMD step cannot resolve host-spilled rows in-jit; it
-  # must fail loudly at construction, not train on zero vectors
+def test_spill_store_without_offload_rejected_by_fused_train_step(mesh):
+  # a spilled store WITHOUT the pinned-host cold block cannot resolve
+  # cold rows in-jit; the fused step must fail loudly at construction,
+  # not train on zero vectors
   n = 40
   rows, cols, _ = ring_edges(n)
   from glt_tpu.data import Dataset
   ds = Dataset(edge_dir='out')
   ds.init_graph(edge_index=np.stack([rows, cols]), num_nodes=n)
   sf = ShardedFeature(np.eye(n, dtype=np.float32), mesh,
-                      split_ratio=0.5)
+                      split_ratio=0.5, host_offload=False)
   import optax
   model = GraphSAGE(hidden_features=8, out_features=4, num_layers=1)
   with pytest.raises(NotImplementedError, match='host-spilled'):
     SPMDSageTrainStep(mesh, model, optax.sgd(1e-2), ds.get_graph(), sf,
                       (np.arange(n) % 4).astype(np.int32), fanouts=[2],
                       batch_size_per_device=4)
+
+
+def test_sharded_feature_spill_legacy_host_phase_parity(mesh):
+  # host_offload=False keeps the lookup()-host-phase fallback exact
+  # (the escape hatch for platforms without memory kinds)
+  n, d = 100, 8
+  feats = np.random.default_rng(21).normal(size=(n, d)) \
+      .astype(np.float32)
+  legacy = ShardedFeature(feats, mesh, split_ratio=0.3,
+                          host_offload=False)
+  assert legacy._spill and legacy.cold_array is None
+  ids = np.random.default_rng(22).integers(0, n, size=8 * 16)
+  np.testing.assert_allclose(np.asarray(legacy.lookup(ids)), feats[ids])
+
+
+def test_fused_train_step_with_host_offloaded_spill(mesh):
+  # the pinned-host cold block (reference unified_tensor.cu:202-231 UVA
+  # analog) lets the fused SPMD step train a spilled store with results
+  # IDENTICAL to the device-resident run
+  import optax
+  from glt_tpu.data import Dataset
+  n = 64
+  rng = np.random.default_rng(23)
+  src = np.repeat(np.arange(n), 3)
+  dst = (src + rng.integers(1, n, src.shape[0])) % n
+  feats = rng.normal(size=(n, 8)).astype(np.float32)
+  labels = rng.integers(0, 4, n).astype(np.int32)
+  ds = Dataset(edge_dir='out')
+  ds.init_graph(edge_index=np.stack([src, dst]), num_nodes=n)
+  model = GraphSAGE(hidden_features=8, out_features=4, num_layers=2)
+  tx = optax.adam(1e-2)
+
+  def losses(sf):
+    step = SPMDSageTrainStep(mesh, model, tx, ds.get_graph(), sf,
+                             labels, fanouts=[3, 2],
+                             batch_size_per_device=4)
+    params = step.init_params(jax.random.key(0))
+    opt = tx.init(params)
+    seeds = np.arange(8 * 4) % n
+    out = []
+    for i in range(2):
+      keys = jax.random.split(jax.random.key(1 + i), 8)
+      params, opt, loss = step(params, opt, seeds, np.full(8, 4), keys)
+      out.append(float(np.asarray(loss)[0]))
+    return out
+
+  spilled = ShardedFeature(feats, mesh, split_ratio=0.4)
+  assert spilled._spill and spilled.cold_array is not None
+  assert (spilled.cold_array.sharding.memory_kind == 'pinned_host')
+  np.testing.assert_allclose(losses(spilled),
+                             losses(ShardedFeature(feats, mesh)),
+                             rtol=1e-6)
 
 
 def test_sharded_feature_bucket_cap_parity(mesh):
