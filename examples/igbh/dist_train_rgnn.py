@@ -84,6 +84,10 @@ def main():
                   help='synthetic scale when --data-root is absent')
   ap.add_argument('--bf16', action=argparse.BooleanOptionalAction,
                   default=True, help='bfloat16 feature store')
+  ap.add_argument('--split-ratio', type=float, default=1.0,
+                  help='<1 spills each partition\'s cold feature tail '
+                       'to pinned host memory, served in-program '
+                       '(beyond-HBM training through the fused step)')
   ap.add_argument('--ckpt-dir', default=None)
   ap.add_argument('--ckpt-steps', type=int, default=200)
   ap.add_argument('--resume', action='store_true')
@@ -211,18 +215,24 @@ def main():
 
   mesh = make_mesh(args.num_devices)
   dtype = jnp.bfloat16 if args.bf16 else None
+  sr = (args.split_ratio if args.split_ratio < 1.0 else None)
   if multihost:
     # each process loads ONLY its local devices' partitions
     dg = dist_hetero_graph_from_partitions_multihost(mesh, part_root)
     dfeats = {t: dist_feature_from_partitions_multihost(
-        mesh, part_root, ntype=t, dtype=dtype) for t in counts}
+        mesh, part_root, ntype=t, dtype=dtype,
+        split_ratio=args.split_ratio) for t in counts}
   else:
     dg = DistHeteroGraph.from_dataset_partitions(mesh, part_root)
     dss = [DistDataset().load(part_root, p)
            for p in range(args.num_devices)]
     dfeats = {t: DistFeature.from_dist_datasets(mesh, dss, ntype=t,
-                                                dtype=dtype)
+                                                dtype=dtype,
+                                                split_ratio=sr)
               for t in counts}
+  if sr is not None:
+    spilled = {t: st.cold_array is not None for t, st in dfeats.items()}
+    print(f'host-offloaded cold blocks active: {spilled}')
   label_dict = {'paper': labels}
 
   model = RGNN(edge_types=[reverse_edge_type(e) for e in etypes],
