@@ -127,6 +127,15 @@ class DistFeature:
         if requested:  # explicitly asked for: do not mask the failure
           raise
         self.cold_array = None  # no memory kinds: keep the host phase
+      if self.cold_array is not None:
+        # host-phase state (and the cold_get rpc surface) is unused
+        # when cold rows are served in-program; keeping the numpy
+        # blocks would double the cold footprint in host RAM. The
+        # routing books stay only for the bucket_cap drain replay.
+        self._host_cold = {}
+        self._host_id2index = {}
+        if not self.bucket_cap:
+          self._host_pb = {}
       self._build_lookup_fn()
 
   def _finish_init(self, mesh: Mesh, axis: str, num_ids: int,
@@ -406,7 +415,15 @@ class DistFeature:
   def cold_get(self, partition: int, ids: np.ndarray) -> np.ndarray:
     """Serve cold rows of a locally-held partition (the rpc-callee
     counterpart of ``cold_fetcher``; reference RpcFeatureLookupCallee,
-    dist_feature.py:57-66)."""
+    dist_feature.py:57-66). Only meaningful on the legacy host-phase
+    path — host-offloaded stores serve cold rows in-program and free
+    this surface's state."""
+    if self.cold_array is not None:
+      raise RuntimeError(
+          'cold_get is the legacy host-phase rpc surface; this store '
+          'host-offloads its cold rows (served in-program) and does '
+          'not retain the numpy blocks — build with host_offload=False '
+          'to use cold_get/cold_fetcher')
     rows = self._host_id2index[int(partition)][np.asarray(ids)]
     return self._host_cold[int(partition)][
         rows - int(self.hot_counts[int(partition)])]
@@ -636,5 +653,10 @@ def dist_feature_from_partitions_multihost(mesh, root_dir: str,
         if host_offload:  # explicitly requested: do not mask the error
           raise
         store.cold_array = None
+      if store.cold_array is not None:
+        store._host_cold = {}
+        store._host_id2index = {}
+        if not store.bucket_cap:
+          store._host_pb = {}
       store._build_lookup_fn()
   return store

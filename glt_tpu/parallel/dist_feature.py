@@ -172,6 +172,10 @@ class ShardedFeature:
         if requested:  # explicitly asked for: do not mask the failure
           raise
         self.cold_array = None  # platform lacks memory kinds: host phase
+      if self.cold_array is not None:
+        # the numpy blocks are the host-phase path's state; keeping
+        # them would double the cold footprint in host RAM
+        self._host_cold = None
     # compiled once; rebuilding shard_map per call would re-trace
     if self.cold_array is not None:
       self._lookup_fn = jax.jit(jax.shard_map(

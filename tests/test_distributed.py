@@ -914,10 +914,16 @@ def test_dist_feature_spill_parity(mesh, dist_datasets):
 
 
 def test_dist_feature_spill_cold_get_roundtrip(mesh, dist_datasets):
-  # the rpc-callee surface: cold_get(partition, ids) must serve exactly
-  # the rows lookup() would have resolved for that partition
+  # the rpc-callee surface (legacy host-phase path): cold_get(partition,
+  # ids) must serve exactly the rows lookup() would have resolved for
+  # that partition. Offloaded stores free this state and refuse.
   df = DistFeature.from_dist_datasets(mesh, dist_datasets,
-                                      split_ratio=0.25)
+                                      split_ratio=0.25,
+                                      host_offload=False)
+  offloaded = DistFeature.from_dist_datasets(mesh, dist_datasets,
+                                             split_ratio=0.25)
+  with pytest.raises(RuntimeError, match='legacy host-phase'):
+    offloaded.cold_get(0, np.arange(2))
   served = 0
   for p, pb in df._host_pb.items():
     if p not in df._host_cold:
