@@ -9,10 +9,11 @@ This benchmark does, and quantifies the spill tax:
     = 20.5 GB > 16 GB v5e HBM; the hot split is what fits), degree-
     sorted so hot rows are the frequently sampled ones (reference
     reorder + UnifiedTensor cache semantics, unified_tensor.cu:202-231);
-  * trains GraphSAGE through NeighborLoader (the ONLY path that admits
-    spill — fused SPMD steps reject it by design) at prefetch_depth
-    {0, 2} and, as the control, the SAME graph with a fully
-    device-resident table;
+  * trains GraphSAGE through NeighborLoader (the loader-driven spill
+    path, which resolves cold rows on host between device calls; the
+    fused-step alternative is measured by bench_fused_spill.py) at
+    prefetch_depth {0, 2} and, as the control, the SAME graph with a
+    fully device-resident table;
   * reports seeds/s for each, the spill/resident throughput ratio, and
     the measured cold rate (fraction of gathered rows served from
     host) — the number that decides whether the default prefetch_depth

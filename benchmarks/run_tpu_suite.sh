@@ -61,6 +61,11 @@ run bench_train_tpu python benchmarks/bench_train.py --epochs 3 --curve
 #    beyond-HBM claim needs this chip run — CPU only measures the ratio)
 run bench_spill_tpu python benchmarks/bench_spill_train.py
 
+# 6b. beyond-HBM through the FUSED step: pinned-host cold blocks served
+#     in-program (compute_on gather) vs device-resident — the offload
+#     tax on real HBM/PCIe, same 20.5 GB table
+run bench_fused_spill_tpu python benchmarks/bench_fused_spill.py
+
 # 7. capped-bucket drain grid (mesh size 1 still lowers the collectives;
 #    round counts come from the deterministic host replay)
 run bench_bucket_drain_tpu python benchmarks/bench_bucket_drain.py
