@@ -68,3 +68,22 @@ from .dist_hetero import dist_hetero_graph_from_partitions_multihost
 __all__ += ['dist_hetero_graph_from_partitions_multihost']
 
 __all__ += ['dist_feature_from_partitions_multihost']
+
+from .dist_feature import PartialFeature
+from .dist_random_partitioner import DistTableRandomPartitioner
+from .rpc import (
+    RpcCalleeBase, RpcClient, RpcDataPartitionRouter, RpcServer,
+    all_gather, barrier, get_rpc_master_addr, get_rpc_master_port,
+    global_all_gather, global_barrier, init_rpc, rpc_is_initialized,
+    rpc_register, rpc_request, rpc_request_async,
+    rpc_sync_data_partitions, shutdown_rpc,
+)
+
+__all__ += [
+    'PartialFeature', 'DistTableRandomPartitioner',
+    'RpcCalleeBase', 'RpcClient', 'RpcDataPartitionRouter', 'RpcServer',
+    'all_gather', 'barrier', 'get_rpc_master_addr',
+    'get_rpc_master_port', 'global_all_gather', 'global_barrier',
+    'init_rpc', 'rpc_is_initialized', 'rpc_register', 'rpc_request',
+    'rpc_request_async', 'rpc_sync_data_partitions', 'shutdown_rpc',
+]

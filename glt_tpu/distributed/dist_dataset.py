@@ -155,27 +155,11 @@ class DistTableDataset(DistDataset):
     worker a disjoint row range the same way); node records contribute
     exactly the (ids, rows) the reader produced.
     """
-    from .dist_random_partitioner import DistRandomPartitioner
-    srcs, dsts = [], []
-    if edge_reader is not None:
-      for rec in edge_reader:
-        srcs.append(as_numpy(rec[0]).astype(np.int64))
-        dsts.append(as_numpy(rec[1]).astype(np.int64))
-    src = np.concatenate(srcs) if srcs else np.zeros(0, np.int64)
-    dst = np.concatenate(dsts) if dsts else np.zeros(0, np.int64)
-    eids = edge_id_offset + np.arange(src.shape[0], dtype=np.int64)
-    ids_l, feats_l = [], []
-    if node_reader is not None:
-      for rec in node_reader:
-        ids_l.append(as_numpy(rec[0]).astype(np.int64))
-        feats_l.append(as_numpy(rec[1]))
-    node_ids = np.concatenate(ids_l) if ids_l else None
-    node_feat = np.concatenate(feats_l) if feats_l else None
-    partitioner = DistRandomPartitioner(
+    from .dist_random_partitioner import DistTableRandomPartitioner
+    partitioner = DistTableRandomPartitioner(
         output_dir, rank=rank, world_size=world_size,
-        num_nodes=num_nodes,
-        edge_slice=np.stack([src, dst]), eid_slice=eids,
-        node_ids=node_ids, node_feat=node_feat,
+        num_nodes=num_nodes, edge_reader=edge_reader,
+        node_reader=node_reader, edge_id_offset=edge_id_offset,
         master_addr=master_addr, master_port=master_port,
         peer_addrs=peer_addrs)
     try:

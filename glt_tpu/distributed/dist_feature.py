@@ -11,7 +11,7 @@ and the per-partition dense id2index maps it to the local row.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +33,14 @@ def _flag_lanes(flag) -> np.ndarray:
     if nz.size:
       lanes.append((s.index[0].start or 0) + nz)
   return (np.concatenate(lanes) if lanes else np.zeros(0, np.int64))
+
+
+#: (rows [M, D], index [M]) — a partition's contribution to a lookup,
+#: positions indexing into the requesting batch (reference
+#: dist_feature.py:37-41 PartialFeature). The collective path stitches
+#: positionally inside the program; this alias types the HOST-side
+#: surfaces (cold_get / cold_fetcher payloads).
+PartialFeature = Tuple[np.ndarray, np.ndarray]
 
 
 class DistFeature:

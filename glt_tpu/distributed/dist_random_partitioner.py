@@ -202,3 +202,41 @@ class DistRandomPartitioner:
     for c in self._clients.values():
       c.close()
     self.server.stop()
+
+
+class DistTableRandomPartitioner(DistRandomPartitioner):
+  """Online random partitioning fed by TABLE readers (reference
+  distributed/dist_table_dataset.py:38 DistTableRandomPartitioner):
+  each rank drains its edge/node table slice — records with EXPLICIT
+  global node ids, as ODPS/CSV shards deliver them — into the slice
+  form the base engine consumes, with global edge ids assigned as
+  ``edge_id_offset + local position`` (ranks pass disjoint offsets,
+  e.g. exclusive prefix sums of their row counts, mirroring the
+  reference's disjoint table row ranges).
+
+  Readers follow glt_tpu.data.table_dataset's protocol: edge readers
+  yield (src_ids, dst_ids[, ...]) records, node readers yield
+  (node_ids, feature_rows).
+  """
+
+  def __init__(self, output_dir: str, rank: int, world_size: int,
+               num_nodes: int, edge_reader=None, node_reader=None,
+               edge_id_offset: int = 0, **kwargs):
+    srcs, dsts = [], []
+    for rec in (edge_reader or ()):
+      srcs.append(as_numpy(rec[0]).astype(np.int64))
+      dsts.append(as_numpy(rec[1]).astype(np.int64))
+    src = np.concatenate(srcs) if srcs else np.zeros(0, np.int64)
+    dst = np.concatenate(dsts) if dsts else np.zeros(0, np.int64)
+    eids = edge_id_offset + np.arange(src.shape[0], dtype=np.int64)
+    ids_l, feats_l = [], []
+    for rec in (node_reader or ()):
+      ids_l.append(as_numpy(rec[0]).astype(np.int64))
+      feats_l.append(as_numpy(rec[1]))
+    super().__init__(
+        output_dir, rank=rank, world_size=world_size,
+        num_nodes=num_nodes, edge_slice=np.stack([src, dst]),
+        eid_slice=eids,
+        node_ids=np.concatenate(ids_l) if ids_l else None,
+        node_feat=np.concatenate(feats_l) if feats_l else None,
+        **kwargs)

@@ -59,6 +59,7 @@ class RpcServer:
     self._barriers: Dict[str, threading.Barrier] = {}
     self._gathers: Dict[str, dict] = {}
     self._lock = threading.Lock()
+    self._reg_cond = threading.Condition(self._lock)
     self.register('_barrier', self._barrier)
     self.register('_gather', self._gather)
     self._accept_thread = None
@@ -77,7 +78,26 @@ class RpcServer:
       self._accept_thread.start()
 
   def register(self, name: str, fn: Callable) -> None:
-    self._callees[name] = fn
+    with self._reg_cond:
+      self._callees[name] = fn
+      self._reg_cond.notify_all()
+
+  def _resolve(self, name: str, timeout: float = 30.0) -> Callable:
+    """Look up a callee, WAITING briefly for late registration — peers
+    discover this server's address before user code finishes
+    registering (the KeyError('push_edges') race the start() docstring
+    documents); a bounded wait turns that race into latency."""
+    deadline = None
+    with self._reg_cond:
+      while name not in self._callees:
+        import time as _time
+        if deadline is None:
+          deadline = _time.monotonic() + timeout
+        remaining = deadline - _time.monotonic()
+        if remaining <= 0 or not self._reg_cond.wait(timeout=remaining):
+          if name not in self._callees:
+            raise KeyError(name)
+      return self._callees[name]
 
   # built-in synchronization callees (reference rpc.py:105-235)
   def _barrier(self, key: str, world: int) -> bool:
@@ -85,19 +105,27 @@ class RpcServer:
       if key not in self._barriers:
         self._barriers[key] = threading.Barrier(world)
       b = self._barriers[key]
-    b.wait(timeout=180)
+    idx = b.wait(timeout=180)
+    if idx == 0:  # one releasee frees the slot (keys are single-use)
+      with self._lock:
+        self._barriers.pop(key, None)
     return True
 
   def _gather(self, key: str, rank: int, world: int, value) -> dict:
     with self._lock:
       slot = self._gathers.setdefault(
-          key, {'vals': {}, 'cond': threading.Condition(self._lock)})
+          key, {'vals': {}, 'served': 0,
+                'cond': threading.Condition(self._lock)})
       slot['vals'][rank] = value
       slot['cond'].notify_all()
       while len(slot['vals']) < world:
         if not slot['cond'].wait(timeout=180):
           raise TimeoutError(f'gather {key} timed out')
-      return dict(slot['vals'])
+      out = dict(slot['vals'])
+      slot['served'] += 1
+      if slot['served'] >= world:  # every rank got its copy: free it
+        self._gathers.pop(key, None)
+      return out
 
   def _accept_loop(self) -> None:
     while not self._stop.is_set():
@@ -118,7 +146,7 @@ class RpcServer:
         except (ConnectionError, EOFError, OSError):
           return
         try:
-          fn = self._callees[name]
+          fn = self._resolve(name)
           _send_msg(conn, ('ok', fn(*args, **kwargs)))
         except BaseException as e:  # deliver errors to the caller
           try:
@@ -182,3 +210,240 @@ class RpcClient:
           self._sock.close()
         finally:
           self._sock = None
+
+
+# ---------------------------------------------------------------------------
+# Reference-shaped any-to-any fabric (reference rpc.py:240-529): a
+# process-global context where every process runs an RpcServer, ranks
+# rendezvous through the master (rank 0 hosts it), and the convenience
+# functions mirror the reference's module surface — init_rpc /
+# rpc_register / rpc_request(_async) / barrier / all_gather (+ global
+# variants) / rpc_sync_data_partitions / RpcDataPartitionRouter.
+# The data plane still rides XLA collectives (SURVEY.md §2.3); this
+# fabric is the control plane plus host-side exchanges (cold_fetcher,
+# online partitioning, server-client choreography).
+
+import abc
+
+
+class RpcCalleeBase(abc.ABC):
+  """Registered callee contract (reference rpc.py:419-433): implement
+  ``call`` and pass the instance to ``rpc_register``."""
+
+  @abc.abstractmethod
+  def call(self, *args, **kwargs):
+    ...
+
+
+class RpcDataPartitionRouter:
+  """Round-robin among the workers serving each data partition
+  (reference rpc.py:364-382)."""
+
+  def __init__(self, partition2workers: Dict[int, List[int]]):
+    self._p2w = {int(p): list(ws)
+                 for p, ws in partition2workers.items()}
+    self._next = {p: 0 for p in self._p2w}
+
+  def get_to_worker(self, partition_idx: int) -> int:
+    ws = self._p2w[int(partition_idx)]
+    i = self._next[int(partition_idx)]
+    self._next[int(partition_idx)] = (i + 1) % len(ws)
+    return ws[i]
+
+
+class _Fabric:
+  def __init__(self, master_addr: str, master_port: int, rank: int,
+               world_size: int, advertise_addr: str = None):
+    self.rank, self.world = int(rank), int(world_size)
+    self.master_addr, self.master_port = master_addr, int(master_port)
+    local_only = master_addr in ('127.0.0.1', 'localhost')
+    self.server = RpcServer(
+        host='127.0.0.1' if local_only else '0.0.0.0')
+    self.master_server = None
+    if self.rank == 0:
+      self.master_server = RpcServer(
+          host='127.0.0.1' if local_only else '0.0.0.0',
+          port=int(master_port))
+    self.master = RpcClient(master_addr, int(master_port),
+                            connect_retries=240, retry_interval=0.25)
+    # rendezvous: everyone contributes the (host, port) its PEERS can
+    # reach — a 0.0.0.0 bind must advertise a routable address (the
+    # UDP-connect trick discovers the interface facing the master; no
+    # packet is sent)
+    host = advertise_addr or self.server.host
+    if host == '0.0.0.0':
+      probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+      try:
+        probe.connect((master_addr, int(master_port)))
+        host = probe.getsockname()[0]
+      finally:
+        probe.close()
+    book = self.master.request(
+        '_gather', 'rpc:addrs', self.rank, self.world,
+        (host, self.server.port))
+    self.addrs = {int(r): tuple(a) for r, a in book.items()}
+    self._clients: Dict[int, RpcClient] = {}
+    self._lock = threading.Lock()
+    self._seq: Dict[str, int] = {}
+
+  def client(self, dst: int) -> RpcClient:
+    # self-requests go through the socket too: one code path
+    dst = int(dst)
+    with self._lock:
+      c = self._clients.get(dst)
+    if c is None:
+      # connect OUTSIDE the lock: a slow/dead peer's retry window must
+      # not stall requests to healthy ranks or seq()
+      c = RpcClient(*self.addrs[dst], connect_retries=40)
+      with self._lock:
+        have = self._clients.get(dst)
+        if have is not None:
+          c.close()
+          return have
+        self._clients[dst] = c
+    return c
+
+  def seq(self, base: str) -> str:
+    # collective calls happen in the same order on every rank, so a
+    # local sequence number makes each collective's master key unique
+    with self._lock:
+      n = self._seq.get(base, 0)
+      self._seq[base] = n + 1
+      return f'{base}:{n}'
+
+  def close(self) -> None:
+    for c in self._clients.values():
+      c.close()
+    self.master.close()
+    self.server.stop()
+    if self.master_server is not None:
+      self.master_server.stop()
+
+
+_fabric: 'Dict[str, _Fabric]' = {}
+
+
+def _role_scope():
+  """(key_prefix, world) of the caller's role group — falls back to the
+  whole fabric when no DistContext is set."""
+  from .dist_context import get_context
+  ctx = get_context()
+  fab = _fabric['ctx']
+  if ctx is None:
+    return 'all', fab.world
+  return f'{ctx.role.name}:{ctx.group_name}', ctx.world_size
+
+
+def init_rpc(master_addr: str = '127.0.0.1', master_port: int = 29388,
+             rank: int = None, world_size: int = None,
+             advertise_addr: str = None) -> None:
+  """Bring up the any-to-any fabric (reference rpc.py:240-346). rank /
+  world_size default to the DistContext's GLOBAL identity.
+  ``master_port`` must be a concrete pre-agreed port — every rank
+  connects to it before any channel exists to share an ephemeral one.
+  ``advertise_addr`` overrides the address peers use to reach THIS
+  rank's server (multihost deployments behind NAT/overlay networks)."""
+  if 'ctx' in _fabric:
+    raise RuntimeError('init_rpc called twice (see shutdown_rpc)')
+  if not int(master_port):
+    raise ValueError('master_port must be a concrete pre-agreed port '
+                     '(port 0 cannot rendezvous: ranks would have no '
+                     'way to learn the ephemeral choice)')
+  if rank is None or world_size is None:
+    from .dist_context import get_context
+    ctx = get_context()
+    if ctx is None:
+      raise ValueError('init_rpc needs rank/world_size when no '
+                       'DistContext is set')
+    rank = ctx.global_rank if rank is None else rank
+    world_size = (ctx.global_world_size if world_size is None
+                  else world_size)
+  _fabric['ctx'] = _Fabric(master_addr, master_port, rank, world_size,
+                           advertise_addr=advertise_addr)
+
+
+def rpc_is_initialized() -> bool:
+  return 'ctx' in _fabric
+
+
+def get_rpc_master_addr() -> str:
+  return _fabric['ctx'].master_addr
+
+
+def get_rpc_master_port() -> int:
+  return _fabric['ctx'].master_port
+
+
+def shutdown_rpc(graceful: bool = True) -> None:
+  """Tear the fabric down; with ``graceful`` every rank waits at a
+  global barrier first so in-flight requests drain (reference
+  rpc.py:349-361). Teardown happens even if the drain barrier fails
+  (a dead peer must not wedge shutdown or leak the fabric)."""
+  fab = _fabric.get('ctx')
+  if fab is None:
+    return
+  try:
+    if graceful:
+      global_barrier()
+  finally:
+    del _fabric['ctx']
+    fab.close()
+
+
+def rpc_register(name: str, callee) -> None:
+  """Register a callee on THIS process's server. Register before any
+  peer can legitimately request ``name`` (the contract the reference
+  enforces with registry-id allocation, rpc.py:435-454)."""
+  fn = callee.call if isinstance(callee, RpcCalleeBase) else callee
+  _fabric['ctx'].server.register(name, fn)
+
+
+def rpc_request(dst_rank: int, name: str, *args, **kwargs):
+  return _fabric['ctx'].client(dst_rank).request(name, *args, **kwargs)
+
+
+def rpc_request_async(dst_rank: int, name: str, *args,
+                      **kwargs) -> Future:
+  return _fabric['ctx'].client(dst_rank).async_request(name, *args,
+                                                       **kwargs)
+
+
+def barrier() -> None:
+  """Role-scoped barrier (reference rpc.py:105-211)."""
+  scope, world = _role_scope()
+  fab = _fabric['ctx']
+  fab.master.request('_barrier', fab.seq(f'bar:{scope}'), world)
+
+
+def all_gather(value) -> dict:
+  """Role-scoped gather: returns {role_rank: value}."""
+  from .dist_context import get_context
+  scope, world = _role_scope()
+  ctx = get_context()
+  rank = _fabric['ctx'].rank if ctx is None else ctx.rank
+  fab = _fabric['ctx']
+  return fab.master.request(
+      '_gather', fab.seq(f'ag:{scope}'), rank, world, value)
+
+
+def global_barrier() -> None:
+  fab = _fabric['ctx']
+  fab.master.request('_barrier', fab.seq('gbar'), fab.world)
+
+
+def global_all_gather(value) -> dict:
+  fab = _fabric['ctx']
+  return fab.master.request('_gather', fab.seq('gag'), fab.rank,
+                            fab.world, value)
+
+
+def rpc_sync_data_partitions(data_partitions) -> Dict[int, List[int]]:
+  """Gather each rank's served partition list and invert it into
+  partition -> [ranks] (reference rpc.py:386-414); feed the result to
+  RpcDataPartitionRouter."""
+  got = all_gather(list(map(int, data_partitions)))
+  out: Dict[int, List[int]] = {}
+  for rank in sorted(got):
+    for p in got[rank]:
+      out.setdefault(int(p), []).append(int(rank))
+  return out
