@@ -15,3 +15,7 @@ from . import ops  # noqa: F401
 from . import sampler  # noqa: F401
 from . import loader  # noqa: F401
 from . import models  # noqa: F401
+from . import channel  # noqa: F401
+from . import partition  # noqa: F401
+from . import parallel  # noqa: F401
+from . import distributed  # noqa: F401

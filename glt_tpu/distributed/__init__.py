@@ -27,7 +27,8 @@ from .dist_sampling_producer import (
 )
 from .channel_loader import MpNeighborLoader, RemoteNeighborLoader
 from .dist_server import (
-    DistServer, init_server, shutdown_server, wait_and_shutdown_server,
+    DistServer, get_server, init_server, shutdown_server,
+    wait_and_shutdown_server,
 )
 from .dist_client import (
     async_request_server, init_client, request_server, shutdown_client,
@@ -74,16 +75,18 @@ from .dist_random_partitioner import DistTableRandomPartitioner
 from .rpc import (
     RpcCalleeBase, RpcClient, RpcDataPartitionRouter, RpcServer,
     all_gather, barrier, get_rpc_master_addr, get_rpc_master_port,
-    global_all_gather, global_barrier, init_rpc, rpc_is_initialized,
-    rpc_register, rpc_request, rpc_request_async,
-    rpc_sync_data_partitions, shutdown_rpc,
+    global_all_gather, global_barrier, init_rpc, rpc_global_request,
+    rpc_global_request_async, rpc_is_initialized, rpc_register,
+    rpc_request, rpc_request_async, rpc_sync_data_partitions,
+    shutdown_rpc,
 )
 
 __all__ += [
-    'PartialFeature', 'DistTableRandomPartitioner',
+    'PartialFeature', 'DistTableRandomPartitioner', 'get_server',
     'RpcCalleeBase', 'RpcClient', 'RpcDataPartitionRouter', 'RpcServer',
     'all_gather', 'barrier', 'get_rpc_master_addr',
     'get_rpc_master_port', 'global_all_gather', 'global_barrier',
-    'init_rpc', 'rpc_is_initialized', 'rpc_register', 'rpc_request',
+    'init_rpc', 'rpc_global_request', 'rpc_global_request_async',
+    'rpc_is_initialized', 'rpc_register', 'rpc_request',
     'rpc_request_async', 'rpc_sync_data_partitions', 'shutdown_rpc',
 ]

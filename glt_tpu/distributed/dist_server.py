@@ -227,3 +227,9 @@ def shutdown_server() -> None:
     _rpc_server.stop()
   _server = None
   _rpc_server = None
+
+
+def get_server() -> Optional[DistServer]:
+  """The process's DistServer singleton (reference
+  dist_server.py:216-221) — None before init_server."""
+  return _server

@@ -68,10 +68,11 @@ class RpcServer:
 
   def start(self) -> None:
     """Begin accepting connections. Callers that register callees after
-    construction MUST use auto_start=False and call start() once
-    registration is complete — otherwise a fast peer can connect in the
-    window before its callee exists (observed under load as
-    KeyError('push_edges'))."""
+    construction should prefer auto_start=False + start() once
+    registration is complete; requests that arrive before a callee
+    exists wait up to 30 s for it (_resolve) before failing — the
+    discovery/registration race (observed under load as
+    KeyError('push_edges')) costs latency, not correctness."""
     if self._accept_thread is None:
       self._accept_thread = threading.Thread(target=self._accept_loop,
                                              daemon=True)
@@ -447,3 +448,10 @@ def rpc_sync_data_partitions(data_partitions) -> Dict[int, List[int]]:
     for p in got[rank]:
       out.setdefault(int(p), []).append(int(rank))
   return out
+
+
+# The fabric is GLOBAL-rank addressed (every process has one identity),
+# so the reference's role-crossing request variants (rpc.py:477-529
+# rpc_global_request*) are the same operation under its names.
+rpc_global_request = rpc_request
+rpc_global_request_async = rpc_request_async
