@@ -80,11 +80,16 @@ def main():
   fanout = [int(x) for x in args.fanout.split(',')]
   train_idx = rng.choice(n, min(n, 200_000), replace=False)
 
-  def build(split_ratio):
+  def build(split_ratio, host_offload=False):
+    # host_offload=False by default: this bench quantifies the LEGACY
+    # host-phase route and the prefetch overlap; the offloaded config
+    # is measured separately below (and the fused-step variant by
+    # bench_fused_spill.py)
     ds = Dataset(edge_dir='out')
     ds.init_graph(edge_index=np.stack([src, dst]), num_nodes=n)
     ds.init_node_features(feats, split_ratio=split_ratio,
-                          sort_func=sort_by_in_degree)
+                          sort_func=sort_by_in_degree,
+                          host_offload=host_offload)
     ds.init_node_labels(labels)
     return ds
 
@@ -151,24 +156,32 @@ def main():
   spill_ds = build(args.split_ratio)
   spill0 = run(spill_ds, 0, count_cold=True)
   spill2 = run(build(args.split_ratio), 2, count_cold=True)
+  # offloaded route: pinned-host cold block served inside the jitted
+  # collate (gather_mixed) — no host phase, prefetch irrelevant
+  offload = run(build(args.split_ratio, host_offload=True), 0)
 
   ratio0 = spill0['seeds_per_s'] / max(resident['seeds_per_s'], 1e-9)
   ratio2 = spill2['seeds_per_s'] / max(resident['seeds_per_s'], 1e-9)
+  ratio_off = offload['seeds_per_s'] / max(resident['seeds_per_s'],
+                                           1e-9)
   table_gb = n * args.feat_dim * 4 / 2**30
   hot_gb = table_gb * args.split_ratio
   dev = jax.devices()[0]
   print(json.dumps({
       'metric': 'spill_train_seeds_per_sec',
-      'value': max(spill0['seeds_per_s'], spill2['seeds_per_s']),
+      'value': max(spill0['seeds_per_s'], spill2['seeds_per_s'],
+                   offload['seeds_per_s']),
       'unit': 'seeds/s',
-      'vs_baseline': round(max(ratio0, ratio2), 4),
+      'vs_baseline': round(max(ratio0, ratio2, ratio_off), 4),
       'detail': {
           'table_gb': round(table_gb, 2), 'hot_gb': round(hot_gb, 2),
           'split_ratio': args.split_ratio,
           'resident': resident,
           'spill_prefetch0': spill0, 'spill_prefetch2': spill2,
+          'spill_offload': offload,
           'ratio_prefetch0': round(ratio0, 4),
           'ratio_prefetch2': round(ratio2, 4),
+          'ratio_offload': round(ratio_off, 4),
           'recommended_prefetch_depth': 2 if ratio2 > ratio0 else 0,
           'wall_s': round(time.time() - t_build, 1),
           'backend': dev.platform},

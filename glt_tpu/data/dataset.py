@@ -95,18 +95,20 @@ class Dataset:
 
   def init_node_features(self, node_feature_data=None,
                          sort_func=None, split_ratio: float = 1.0,
-                         dtype=None, device=None):
+                         dtype=None, device=None, host_offload=None):
     """``sort_func`` (e.g. sort_by_in_degree) reorders rows so the hot
     prefix is device-resident; the resulting old->new map is installed as
     the Feature's id2index so lookups by original id keep working
-    (reference dataset.py:236-298)."""
+    (reference dataset.py:236-298). ``host_offload`` forwards to
+    Feature (pinned-host cold block vs numpy host phase)."""
     def build(feats, topo):
       feats = as_numpy(feats)
       id2index = None
       if sort_func is not None and topo is not None:
         feats, id2index = sort_func(feats, split_ratio, topo)
       return Feature(feats, split_ratio=split_ratio, id2index=id2index,
-                     dtype=dtype, device=device)
+                     dtype=dtype, device=device,
+                     host_offload=host_offload)
 
     if isinstance(node_feature_data, dict):
       self.node_features = {}

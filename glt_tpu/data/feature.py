@@ -9,9 +9,11 @@ pinned-CPU zero-copy part read over UVA inside GatherTensorKernel
   * hot rows  -> one jax array in HBM, gathered in-jit (``jnp.take``; the
     XLA gather runs at HBM bandwidth which is exactly what the warp-per-row
     GatherTensorKernel achieves on GPU);
-  * cold rows -> numpy in host RAM; gathered on host and ``device_put`` per
-    batch (the PCIe/UVA analogue). The loader overlaps this host stage with
-    device compute, which replaces the reference's zero-copy latency hiding.
+  * cold rows -> by default ALSO a pinned-host jax array gathered inside
+    the jitted collate (``gather_mixed``: a compute_on('device_host') read
+    staged by XLA — the true zero-copy/UVA analogue); with
+    host_offload=False, numpy in host RAM gathered between device calls,
+    overlapped by the loader's prefetch thread.
 
 DeviceGroup/NVLink replication (feature.py:179-199) and CUDA-IPC sharing
 (feature.py:209-261) have no TPU equivalent: under SPMD one sharded global
@@ -27,6 +29,40 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils import as_numpy
+
+
+@jax.jit
+def _mixed_gather(hot: jax.Array, cold: jax.Array,
+                  rows: jax.Array) -> jax.Array:
+  """hot [H, D] device block; cold [C, D] pinned-host block; rows [B]
+  absolute row indices (cold row r lives at cold[r - H]). Index
+  arithmetic stays on device; the cold read runs host-side via raw
+  indexing (bounds ops would materialize device-space constants inside
+  the host region)."""
+  from jax.experimental import compute_on
+  h = hot.shape[0]
+  cold_idx = jnp.clip(rows - h, 0, cold.shape[0] - 1)
+  idx_h = jax.device_put(cold_idx, jax.memory.Space.Host)
+  with compute_on.compute_on('device_host'):
+    c = cold[idx_h]
+  c = jax.device_put(c, jax.memory.Space.Device)
+  if h == 0:  # static shape: the whole table is cold
+    return c
+  x = jnp.take(hot, jnp.where(rows < h, rows, 0), axis=0)
+  return jnp.where((rows >= h)[:, None], c.astype(x.dtype), x)
+
+
+@jax.jit
+def _host_rows_gather(cold: jax.Array, idx: jax.Array) -> jax.Array:
+  """Read rows of a pinned-host block (eager gathers cannot mix memory
+  spaces, so even host-side convenience reads go through this jitted
+  compute_on program)."""
+  from jax.experimental import compute_on
+  idx_h = jax.device_put(jnp.clip(idx, 0, cold.shape[0] - 1),
+                         jax.memory.Space.Host)
+  with compute_on.compute_on('device_host'):
+    out = cold[idx_h]
+  return jax.device_put(out, jax.memory.Space.Device)
 
 
 class Feature:
@@ -51,7 +87,7 @@ class Feature:
   def __init__(self, feats, split_ratio: float = 1.0,
                id2index: Optional[np.ndarray] = None,
                device: Optional[jax.Device] = None,
-               dtype=None):
+               dtype=None, host_offload: Optional[bool] = None):
     feats = as_numpy(feats)
     if feats.ndim == 1:
       feats = feats[:, None]
@@ -64,6 +100,13 @@ class Feature:
     self._id2index_dev = None
     self._hot = None
     self._cold = None
+    # host_offload: None = auto (on when spilled unless
+    # GLT_HOST_OFFLOAD=0) — cold rows then ALSO live as a pinned-host
+    # jax array served in-jit by gather_mixed (the UVA analog,
+    # reference unified_tensor.cu:202-231); False keeps only the
+    # numpy host phase (gather_cold_host)
+    self._host_offload = host_offload
+    self.cold_array = None
     self._initialized = False
 
   # -- lazy split/placement (reference lazy-init pattern, feature.py:29) --
@@ -79,6 +122,19 @@ class Feature:
     if self._id2index is not None:
       self._id2index_dev = jax.device_put(
           jnp.asarray(self._id2index), self.device)
+    from ..utils.offload import maybe_pin_host, offload_requested
+    self._cold_count = int(self._cold.shape[0])
+    if offload_requested(self._host_offload, self._cold_count > 0) \
+        and self._cold_count:
+      self.cold_array = maybe_pin_host(
+          lambda: jax.device_put(
+              jnp.asarray(self._cold, dtype=self.dtype),
+              jax.memory.Space.Host),
+          self._host_offload)
+      if self.cold_array is not None:
+        # the pinned block IS the cold copy; keeping the numpy view
+        # would pin _host_full and double the cold footprint
+        self._cold = None
     self._host_full = None  # single-copy invariant, as in the reference
     self._initialized = True
 
@@ -87,7 +143,8 @@ class Feature:
   @property
   def shape(self):
     if self._initialized:
-      return (self._hot.shape[0] + self._cold.shape[0], self._hot.shape[1])
+      return (self._hot.shape[0] + self._cold_count,
+              self._hot.shape[1])
     return self._host_full.shape
 
   @property
@@ -133,12 +190,38 @@ class Feature:
           rows.shape + (self._hot.shape[1],))
     return jnp.take(self._hot, rows, axis=0, mode='clip')
 
+  def gather_mixed(self, rows: jax.Array) -> jax.Array:
+    """Jit-served gather over BOTH residency classes: hot rows from the
+    device block, cold rows from the pinned-host block via a
+    compute_on('device_host') gather — one compiled program, no host
+    phase between batches. Requires the offloaded cold block
+    (``cold_array``); loaders fall back to gather_cold_host otherwise."""
+    self.lazy_init()
+    assert self.cold_array is not None, 'host offload inactive'
+    return _mixed_gather(self._hot, self.cold_array, rows)
+
+  def cold_block_numpy(self) -> np.ndarray:
+    """The whole cold block as numpy, whichever residency holds it
+    (store builders reassemble [hot | cold] through this)."""
+    self.lazy_init()
+    if self._cold is not None:
+      return self._cold
+    if self.cold_array is not None:
+      return np.asarray(self.cold_array)
+    return np.zeros((0, self.feature_dim), self.dtype)
+
   def gather_cold_host(self, rows: np.ndarray) -> np.ndarray:
     """Host gather of cold rows (rows are absolute; caller pre-filters
-    rows >= hot_count). The UVA-read analogue."""
+    rows >= hot_count). The UVA-read analogue; offloaded stores serve
+    the same rows from the pinned block."""
     self.lazy_init()
+    if self._cold is not None:
+      return np.asarray(
+          self._cold[rows - self.hot_count], dtype=self.dtype)
     return np.asarray(
-        self._cold[rows - self.hot_count], dtype=self.dtype)
+        _host_rows_gather(self.cold_array,
+                          jnp.asarray(rows - self.hot_count)),
+        dtype=self.dtype)
 
   def __getitem__(self, ids) -> np.ndarray:
     """Host-side convenience lookup returning numpy (reference cpu_get,

@@ -116,25 +116,18 @@ class DistFeature:
     # SPMD train steps can consume spilled stores and lookup() needs no
     # host phase. Default on when spilling (GLT_HOST_OFFLOAD=0 or
     # host_offload=False opt out).
-    import os
-    requested = host_offload
-    if host_offload is None:
-      host_offload = (self._spill
-                      and os.environ.get('GLT_HOST_OFFLOAD', '1') != '0')
-    if host_offload and self._spill and self._host_cold:
+    from ..utils.offload import maybe_pin_host, offload_requested
+    if offload_requested(host_offload, self._spill) and self._host_cold:
       c_max = max(c.shape[0] for c in self._host_cold.values())
       np_dtype = np.dtype(self.array.dtype)
       stack = np.zeros((n_parts, c_max, self.feature_dim), np_dtype)
       for p, c in self._host_cold.items():
         stack[p, :c.shape[0]] = c
-      try:
-        self.cold_array = jax.device_put(
-            stack, NamedSharding(mesh, P(axis),
-                                 memory_kind='pinned_host'))
-      except Exception:
-        if requested:  # explicitly asked for: do not mask the failure
-          raise
-        self.cold_array = None  # no memory kinds: keep the host phase
+      self.cold_array = maybe_pin_host(
+          lambda: jax.device_put(
+              stack, NamedSharding(mesh, P(axis),
+                                   memory_kind='pinned_host')),
+          host_offload)
       if self.cold_array is not None:
         # host-phase state (and the cold_get rpc surface) is unused
         # when cold rows are served in-program; keeping the numpy
@@ -488,7 +481,7 @@ class DistFeature:
         # (Feature(dtype=bf16)) survives instead of promoting the stack
         block = np.concatenate(
             [np.asarray(feat.device_part, dtype=feat.dtype),
-             np.asarray(feat._cold, dtype=feat.dtype)])
+             np.asarray(feat.cold_block_numpy(), dtype=feat.dtype)])
       hots.append(feat.hot_count if split_ratio is None
                   else int(round(block.shape[0] * float(split_ratio))))
       parts.append((block, feat._id2index))
@@ -631,12 +624,8 @@ def dist_feature_from_partitions_multihost(mesh, root_dir: str,
       mesh, stack_or_empty(maps_l, (num_ids,), np.int32), axis)
   store.feat_pb = global_from_local(
       mesh, stack_or_empty(pbs_l, (num_ids,), np.int32), axis)
-  import os
-  if host_offload is None:
-    offload = spill and os.environ.get('GLT_HOST_OFFLOAD', '1') != '0'
-  else:
-    offload = bool(host_offload)
-  if offload and spill:
+  from ..utils.offload import maybe_pin_host, offload_requested
+  if offload_requested(host_offload, spill) and spill:
     # global cold capacity must be agreed (it is baked into every
     # process's trace); partitions are disjoint, so max-allgather
     local_cmax = max((c.shape[0] for c in store._host_cold.values()),
@@ -654,13 +643,10 @@ def dist_feature_from_partitions_multihost(mesh, root_dir: str,
         c = store._host_cold.get(p)
         if c is not None:
           local_stack[i, :c.shape[0]] = c
-      try:
-        store.cold_array = global_from_local(
-            mesh, local_stack, axis, memory_kind='pinned_host')
-      except Exception:
-        if host_offload:  # explicitly requested: do not mask the error
-          raise
-        store.cold_array = None
+      store.cold_array = maybe_pin_host(
+          lambda: global_from_local(mesh, local_stack, axis,
+                                    memory_kind='pinned_host'),
+          host_offload)
       if store.cold_array is not None:
         store._host_cold = {}
         store._host_id2index = {}

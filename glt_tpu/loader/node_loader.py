@@ -73,15 +73,21 @@ class NodeLoader:
   @staticmethod
   def _has_host_phase(data) -> bool:
     """True when collation must touch host RAM per batch (spilled
-    feature rows), so a prefetch thread has latency to hide."""
+    feature rows WITHOUT a host-offloaded cold block), so a prefetch
+    thread has latency to hide. Offloaded stores serve cold rows
+    inside the jitted collate — nothing to overlap."""
     stores = []
     for feats in (data.node_features, data.edge_features):
       if isinstance(feats, dict):
         stores.extend(feats.values())
       elif feats is not None:
         stores.append(feats)
-    return any(getattr(f, 'fully_device_resident', True) is False
-               for f in stores)
+    def host_phase(f):
+      if getattr(f, 'fully_device_resident', True):
+        return False
+      f.lazy_init()  # offload is decided at placement time
+      return f.cold_array is None
+    return any(host_phase(f) for f in stores)
 
   def __len__(self):
     n = self.seeds.shape[0]
@@ -130,10 +136,18 @@ class NodeLoader:
     rows = feat.map_ids(node)
     if feat.fully_device_resident:
       return feat.device_gather(rows)
-    # mixed residency: hot rows stay on device end-to-end; only the cold
-    # slice crosses host->device (the UVA-read analogue). The previous
-    # design pulled the hot gather D2H and re-uploaded the whole batch —
-    # hot rows crossed PCIe twice, defeating the split.
+    feat.lazy_init()  # offload is decided at placement time
+    if feat.cold_array is not None:
+      # host-offloaded cold block: one jitted program serves both
+      # residency classes (compute_on host gather inside) — no host
+      # phase between batches at all (jnp.asarray is a no-op for rows
+      # already on device)
+      return feat.gather_mixed(jnp.asarray(rows))
+    # legacy mixed residency (host_offload=False): hot rows stay on
+    # device end-to-end; only the cold slice crosses host->device (the
+    # UVA-read analogue). The previous design pulled the hot gather D2H
+    # and re-uploaded the whole batch — hot rows crossed PCIe twice,
+    # defeating the split.
     rows_np = as_numpy(rows).astype(np.int64)
     if feat.hot_count == 0:
       # no device block at all (split_ratio=0.0): the whole batch is

@@ -156,22 +156,14 @@ class ShardedFeature:
     # spilled stores; without it cold rows resolve in lookup()'s host
     # phase between device calls. Default: on when spilling (opt out
     # with GLT_HOST_OFFLOAD=0 or host_offload=False).
-    import os
-    requested = host_offload
-    if host_offload is None:
-      host_offload = (self._spill
-                      and os.environ.get('GLT_HOST_OFFLOAD', '1') != '0')
+    from ..utils.offload import maybe_pin_host, offload_requested
     self.cold_array = None
-    if host_offload and self._spill:
-      cold = np.concatenate(self._host_cold)
-      try:
-        self.cold_array = jax.device_put(
-            cold, NamedSharding(mesh, P(axis),
-                                memory_kind='pinned_host'))
-      except Exception:
-        if requested:  # explicitly asked for: do not mask the failure
-          raise
-        self.cold_array = None  # platform lacks memory kinds: host phase
+    if offload_requested(host_offload, self._spill) and self._spill:
+      self.cold_array = maybe_pin_host(
+          lambda: jax.device_put(
+              np.concatenate(self._host_cold),
+              NamedSharding(mesh, P(axis), memory_kind='pinned_host')),
+          host_offload)
       if self.cold_array is not None:
         # the numpy blocks are the host-phase path's state; keeping
         # them would double the cold footprint in host RAM

@@ -15,6 +15,10 @@ if 'xla_force_host_platform_device_count' not in _flags:
   os.environ['XLA_FLAGS'] = (
       _flags + ' --xla_force_host_platform_device_count=8').strip()
 
+# the suite's offload assertions assume the documented default (auto-on
+# when spilled); an ambient GLT_HOST_OFFLOAD=0 opt-out must not leak in
+os.environ.pop('GLT_HOST_OFFLOAD', None)
+
 import jax
 
 jax.config.update('jax_platforms', 'cpu')

@@ -24,7 +24,8 @@ def ring_edges(num_nodes: int):
 
 def ring_dataset(num_nodes: int = 40, feat_dim: int = 16,
                  edge_feat_dim: int = 4, edge_dir: str = 'out',
-                 split_ratio: float = 1.0, weighted: bool = False) -> Dataset:
+                 split_ratio: float = 1.0, weighted: bool = False,
+                 host_offload=None) -> Dataset:
   rows, cols, eids = ring_edges(num_nodes)
   weights = (eids % 7 + 1).astype(np.float32) if weighted else None
   ds = Dataset(edge_dir=edge_dir)
@@ -34,7 +35,8 @@ def ring_dataset(num_nodes: int = 40, feat_dim: int = 16,
                   (1, feat_dim))
   efeat = np.tile(np.arange(2 * num_nodes, dtype=np.float32)[:, None],
                   (1, edge_feat_dim))
-  ds.init_node_features(nfeat, split_ratio=split_ratio)
+  ds.init_node_features(nfeat, split_ratio=split_ratio,
+                        host_offload=host_offload)
   ds.init_edge_features(efeat)
   ds.init_node_labels(np.arange(num_nodes, dtype=np.int32) % 4)
   return ds

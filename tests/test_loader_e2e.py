@@ -91,9 +91,11 @@ def test_all_cold_feature_store_loader():
 
 
 def test_prefetch_depth_auto_default():
-  # spilled stores have a host phase per batch -> overlap by default;
-  # fully resident stores have nothing to hide -> no prefetch thread
-  spilled = ring_dataset(num_nodes=40, split_ratio=0.3)
+  # LEGACY spilled stores (no offloaded cold block) have a host phase
+  # per batch -> overlap by default; fully resident stores — and
+  # offloaded spill, tested in test_feature.py — have nothing to hide
+  spilled = ring_dataset(num_nodes=40, split_ratio=0.3,
+                         host_offload=False)
   resident = ring_dataset(num_nodes=40)
   l_spill = NeighborLoader(spilled, [2], input_nodes=np.arange(8),
                            batch_size=8, seed=0)
