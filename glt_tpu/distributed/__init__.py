@@ -90,3 +90,8 @@ __all__ += [
     'rpc_is_initialized', 'rpc_register', 'rpc_request',
     'rpc_request_async', 'rpc_sync_data_partitions', 'shutdown_rpc',
 ]
+
+from .dist_loader import DistLoader
+from .event_loop import ConcurrentEventLoop
+
+__all__ += ['DistLoader', 'ConcurrentEventLoop']

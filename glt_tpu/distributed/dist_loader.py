@@ -118,3 +118,10 @@ class DistNeighborLoader:
         out['y'] = self.labels[np.maximum(np.asarray(out['batch']), 0)]
       out['n_valid'] = n_valid
       yield out
+
+
+#: Reference-name compatibility (distributed/dist_loader.py:46): the
+#: reference's generic DistLoader base carries the collocated/mp/remote
+#: mode dispatch that here lives directly in DistNeighborLoader (and
+#: the channel loaders); node-seeded loading IS the generic entry.
+DistLoader = DistNeighborLoader

@@ -1,0 +1,68 @@
+"""Bounded host-side task concurrency.
+
+Reference: graphlearn_torch/python/distributed/event_loop.py (asyncio
+daemon-thread loop + BoundedSemaphore backpressure driving concurrent
+sampling tasks). On TPU the DEVICE pipeline needs none of this — XLA
+dispatch is already async and the fused SPMD steps are one program —
+so this exists for the surfaces that stay host-side: partition-block
+I/O, rpc fan-out (cold fetchers, producer control), channel prefetch.
+A thread pool with a bounded in-flight window gives the same
+``add_task``/``run_task``/``wait_all`` contract without an asyncio
+dependency.
+"""
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, List, Optional
+
+
+class ConcurrentEventLoop:
+  """Reference event_loop.py:39-102 surface: submit up to
+  ``concurrency`` tasks in flight; ``add_task`` blocks when the window
+  is full (the reference's BoundedSemaphore backpressure), ``run_task``
+  executes synchronously through the same window, ``wait_all`` joins
+  every outstanding task (re-raising the first failure)."""
+
+  def __init__(self, concurrency: int = 32):
+    assert concurrency > 0
+    self._sem = threading.BoundedSemaphore(concurrency)
+    self._pool = ThreadPoolExecutor(max_workers=concurrency)
+    self._pending: List[Future] = []
+    self._lock = threading.Lock()
+
+  def _wrap(self, fn: Callable, args, kwargs):
+    try:
+      return fn(*args, **kwargs)
+    finally:
+      self._sem.release()
+
+  def add_task(self, fn: Callable, *args,
+               callback: Optional[Callable] = None, **kwargs) -> Future:
+    """Submit; blocks while ``concurrency`` tasks are in flight.
+    ``callback`` (if given) receives the result on completion."""
+    self._sem.acquire()
+    fut = self._pool.submit(self._wrap, fn, args, kwargs)
+    if callback is not None:
+      fut.add_done_callback(lambda f: callback(f.result()))
+    with self._lock:
+      self._pending.append(fut)
+    return fut
+
+  def run_task(self, fn: Callable, *args, **kwargs):
+    """Synchronous execution through the same backpressure window."""
+    return self.add_task(fn, *args, **kwargs).result()
+
+  def wait_all(self) -> None:
+    """Join every outstanding task; re-raises the first failure."""
+    while True:
+      with self._lock:
+        if not self._pending:
+          return
+        pending, self._pending = self._pending, []
+      for f in pending:
+        f.result()
+
+  def shutdown(self) -> None:
+    self.wait_all()
+    self._pool.shutdown(wait=True)

@@ -110,3 +110,35 @@ def test_rpc_server_waits_for_late_registration():
     client.close()
   finally:
     server.stop()
+
+
+def test_concurrent_event_loop():
+  import threading
+  import time
+  from glt_tpu.distributed import ConcurrentEventLoop
+  loop = ConcurrentEventLoop(concurrency=2)
+  active = [0]
+  peak = [0]
+  lock = threading.Lock()
+
+  def task(i):
+    with lock:
+      active[0] += 1
+      peak[0] = max(peak[0], active[0])
+    time.sleep(0.05)
+    with lock:
+      active[0] -= 1
+    return i * 2
+
+  got = []
+  for i in range(6):
+    loop.add_task(task, i, callback=got.append)
+  loop.wait_all()
+  assert sorted(got) == [0, 2, 4, 6, 8, 10]
+  assert peak[0] <= 2  # bounded in-flight window
+  assert loop.run_task(task, 21) == 42
+  # failures surface at wait_all
+  loop.add_task(lambda: (_ for _ in ()).throw(RuntimeError('boom')))
+  with pytest.raises(RuntimeError, match='boom'):
+    loop.wait_all()
+  loop.shutdown()
