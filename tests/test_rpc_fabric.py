@@ -142,3 +142,36 @@ def test_concurrent_event_loop():
   with pytest.raises(RuntimeError, match='boom'):
     loop.wait_all()
   loop.shutdown()
+
+
+def _role_worker(rank: int, world: int, port: int, q) -> None:
+  try:
+    from glt_tpu.distributed import (
+        all_gather, barrier, init_rpc, init_worker_group, shutdown_rpc,
+    )
+    # role-scoped collectives resolve identity + world from the
+    # DistContext (reference role-group all_gather, rpc.py:105-211)
+    init_worker_group(world_size=world, rank=rank)
+    init_rpc('127.0.0.1', port)  # rank/world from the context
+    barrier()
+    got = all_gather(rank + 100)
+    assert got == {r: r + 100 for r in range(world)}, got
+    shutdown_rpc()
+    q.put((rank, 'ok'))
+  except BaseException as e:
+    q.put((rank, f'FAIL: {type(e).__name__}: {e}'))
+
+
+def test_rpc_fabric_role_scoped_collectives():
+  world = 2
+  port = _free_port()
+  ctx = mp.get_context('spawn')
+  q = ctx.Queue()
+  procs = [ctx.Process(target=_role_worker, args=(r, world, port, q))
+           for r in range(world)]
+  for p in procs:
+    p.start()
+  results = [q.get(timeout=600) for _ in range(world)]
+  for p in procs:
+    p.join(timeout=120)
+  assert all(msg == 'ok' for _, msg in results), results
