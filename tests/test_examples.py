@@ -112,3 +112,9 @@ def test_gpt_on_graphs_example():
              '--num-batches', '1', timeout=300)
   assert 'Papers:' in out and 'Known citations' in out
   assert 'Question: based only on the structure above' in out
+
+
+def test_trim_example():
+  out = _run('train_sage_with_trim.py', '--nodes', '600',
+             '--fanout', '5,3', timeout=420)
+  assert 'trim=True' in out and 'done' in out
