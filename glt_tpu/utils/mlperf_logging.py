@@ -48,8 +48,11 @@ class MLLogger:
   def run_start(self):
     self.start('run_start')
 
-  def run_stop(self, status: str = 'success'):
-    self.end('run_stop', metadata={'status': status})
+  def run_stop(self, status: str = 'success', epoch: int = None):
+    md = {'status': status}
+    if epoch is not None:
+      md['epoch_num'] = epoch
+    self.end('run_stop', metadata=md)
 
   def epoch_start(self, epoch: int):
     self.start('epoch_start', metadata={'epoch_num': epoch})
@@ -57,5 +60,31 @@ class MLLogger:
   def epoch_stop(self, epoch: int):
     self.end('epoch_stop', metadata={'epoch_num': epoch})
 
+  def eval_start(self, epoch: int):
+    self.start('eval_start', metadata={'epoch_num': epoch})
+
+  def eval_stop(self, epoch: int):
+    self.end('eval_stop', metadata={'epoch_num': epoch})
+
   def eval_accuracy(self, value: float, epoch: int):
     self.event('eval_accuracy', value, metadata={'epoch_num': epoch})
+
+  # submission/init block — the reference emits these via the official
+  # mlperf_logging constants (examples/igbh/mlperf_logging_utils.py:12-33,
+  # dist_train_rgnn.py:345-346,435-440); same key strings here so result
+  # parsers treat the two logs identically.
+  def submission_info(self, benchmark: str = 'GNN',
+                      submitter: str = 'glt_tpu',
+                      platform: str = 'tpu'):
+    self.event('submission_benchmark', benchmark)
+    self.event('submission_org', submitter)
+    self.event('submission_division', 'closed')
+    self.event('submission_status', 'onprem')
+    self.event('submission_platform', platform)
+
+  def init_start(self):
+    self.event('cache_clear', True)
+    self.start('init_start')
+
+  def init_stop(self):
+    self.end('init_stop')
