@@ -34,6 +34,10 @@ run_bench() {  # bench.py steps: self-supervising (probe child + budget),
 # The first successful step doubles as the compile-cache prime: bench.py
 # writes .jax_cache, which the driver's end-of-round run reuses.
 run_bench bench_sort_scan4 python bench.py
+# 1a. fused hop assign (GLT_FUSED_HOP): single-sort dedup targeting the
+#     profiled 41 ms assign_h2 stage — ordered right after the headline
+#     so any tunnel window captures the A/B (VERDICT r4 next #2)
+run_bench bench_sort_fusedhop env GLT_FUSED_HOP=1 python bench.py
 run_bench bench_table_scan4 env GLT_DEDUP=table python bench.py
 run_bench bench_sort_scan1 env GLT_BENCH_SCAN=1 python bench.py
 run_bench bench_sort_scan8 env GLT_BENCH_SCAN=8 python bench.py
@@ -45,6 +49,8 @@ run microbench_prims_tpu python benchmarks/microbench_prims.py
 # 3. stage breakdown + profiler trace (top-op evidence)
 run profile_sampler_tpu python benchmarks/profile_sampler.py \
     --trace /tmp/glt_trace
+run profile_sampler_fused env GLT_FUSED_HOP=1 \
+    python benchmarks/profile_sampler.py
 
 # 4. feature gather: XLA vs Pallas row-DMA
 run bench_feature_xla python benchmarks/bench_feature.py

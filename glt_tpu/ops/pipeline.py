@@ -16,7 +16,8 @@ import jax.numpy as jnp
 
 from .sample import NeighborOutput
 from .unique import (dense_assign, dense_init, dense_reset,
-                     sorted_hop_dedup, sorted_nodes_by_label)
+                     sorted_hop_dedup, sorted_hop_dedup_fused,
+                     sorted_nodes_by_label)
 
 OneHopFn = Callable[[jax.Array, int, jax.Array, jax.Array], NeighborOutput]
 
@@ -35,6 +36,18 @@ def dedup_engine() -> str:
   if mode == 'auto':
     return 'sort' if jax.default_backend() == 'tpu' else 'table'
   return mode
+
+
+def fused_hops() -> bool:
+  """GLT_FUSED_HOP=1 switches the sort engine's per-hop assign stage to
+  :func:`glt_tpu.ops.unique.sorted_hop_dedup_fused` (one narrow sort +
+  one packed scatter per hop instead of two wide sorts; within-hop new
+  labels come out in value order rather than slot order — see its
+  docstring for why that is the only observable change). The seed hop
+  always stays on the exact path so ``batch``/``seed_labels`` remain
+  bit-identical to the table engine. Read at trace time, like
+  :func:`dedup_engine`."""
+  return os.environ.get('GLT_FUSED_HOP', '0').lower() in ('1', 'true')
 
 
 def checksum_outputs(out: Dict[str, jax.Array]) -> jax.Array:
@@ -209,6 +222,7 @@ def _multihop_sample_sorted(one_hop: OneHopFn,
   frontier_labels = d['labels3']
   frontier_mask = d['new_head3']
 
+  fused = fused_hops()
   rows_parent, cols_child, emasks, eid_list = [], [], [], []
   hop_node_counts = [seed_count]
   hop_edge_counts = []
@@ -217,19 +231,34 @@ def _multihop_sample_sorted(one_hop: OneHopFn,
     key, sub = jax.random.split(key)
     out = one_hop(frontier_ids, fanout, sub, frontier_mask)
     rows_flat = jnp.repeat(frontier_labels, width)
-    eflat = out.eids.reshape(-1) if with_edge else None
-    d = sorted_hop_dedup(u_ids, u_labs, count, out.nbrs.reshape(-1),
-                         out.mask.reshape(-1), rows_flat, eflat,
-                         with_mask=True)
+    ids_flat = out.nbrs.reshape(-1)
+    mask_flat = out.mask.reshape(-1)
+    if fused:
+      # single-sort assign; per-element outputs come back in SLOT
+      # order, so edge payloads (rows/mask/eids) never ride a sort
+      d = sorted_hop_dedup_fused(u_ids, u_labs, count, ids_flat,
+                                 mask_flat)
+      rows_parent.append(rows_flat)
+      cols_child.append(d['labels3'])
+      emasks.append(mask_flat)
+      if with_edge:
+        eid_list.append(out.eids.reshape(-1))
+      frontier_ids = jnp.where(d['new_head3'],
+                               ids_flat.astype(jnp.int32),
+                               jnp.iinfo(jnp.int32).max)
+    else:
+      eflat = out.eids.reshape(-1) if with_edge else None
+      d = sorted_hop_dedup(u_ids, u_labs, count, ids_flat, mask_flat,
+                           rows_flat, eflat, with_mask=True)
+      rows_parent.append(d['rows3'])
+      cols_child.append(d['labels3'])
+      emasks.append(d['mask3'])
+      if with_edge:
+        eid_list.append(d['eids3'])
+      frontier_ids = d['ids3']
     u_ids, u_labs, count = d['u_ids2'], d['u_labs2'], d['count2']
-    rows_parent.append(d['rows3'])
-    cols_child.append(d['labels3'])
-    emasks.append(d['mask3'])
-    if with_edge:
-      eid_list.append(d['eids3'])
     hop_node_counts.append(d['new_count'])
     hop_edge_counts.append(out.mask.sum().astype(jnp.int32))
-    frontier_ids = d['ids3']
     frontier_labels = d['labels3']
     frontier_mask = d['new_head3']
 
@@ -446,15 +475,24 @@ def _multihop_sample_hetero_sorted(one_hops, trav, num_neighbors,
         continue
       ids = jnp.concatenate([c[0] for c in chunks])
       ok = jnp.concatenate([c[1] for c in chunks])
-      # rows/mask/eids are NOT threaded through the sorts here: the hop's
-      # edge buffers are rebuilt in slot order below (per_meta), so the
-      # dedup sorts stay as narrow as possible
-      d = sorted_hop_dedup(*seen[t], ids, ok)
+      if fused_hops():
+        # single-sort assign already returns slot order — the
+        # per-(type, hop) un-permuting sort below disappears too
+        d = sorted_hop_dedup_fused(*seen[t], ids, ok)
+        labels_by_type[t] = d['labels3']
+        frontier[t] = (jnp.where(d['new_head3'], ids.astype(jnp.int32),
+                                 jnp.iinfo(jnp.int32).max),
+                       d['labels3'], d['new_head3'])
+      else:
+        # rows/mask/eids are NOT threaded through the sorts here: the
+        # hop's edge buffers are rebuilt in slot order below (per_meta),
+        # so the dedup sorts stay as narrow as possible
+        d = sorted_hop_dedup(*seen[t], ids, ok)
+        # slot-order labels: cols for this hop's edge buffers
+        labels_by_type[t] = jax.lax.sort([d['pos3'], d['labels3']],
+                                         num_keys=1)[1]
+        frontier[t] = (d['ids3'], d['labels3'], d['new_head3'])
       seen[t] = (d['u_ids2'], d['u_labs2'], d['count2'])
-      # slot-order labels: cols for this hop's edge buffers
-      labels_by_type[t] = jax.lax.sort([d['pos3'], d['labels3']],
-                                       num_keys=1)[1]
-      frontier[t] = (d['ids3'], d['labels3'], d['new_head3'])
       hop_nodes[t].append(d['new_count'])
     cursor = {t: 0 for t in types}
     for e, col_t, rows_parent, mask, eids, width in per_meta:

@@ -303,6 +303,82 @@ def sorted_hop_dedup(
               count2=count + new_count, new_count=new_count, **out_pay)
 
 
+def sorted_hop_dedup_fused(
+    u_ids: jax.Array,    # [C] seen-set ids (append-form, _BIG padding)
+    u_labs: jax.Array,   # [C] their labels (_BIG at padding)
+    count: jax.Array,    # scalar int32: labels assigned so far
+    ids: jax.Array,      # [M] sampled ids for this hop (dups allowed)
+    valid: jax.Array,    # [M]
+):
+  """One hop of dedup/relabel with ONE 3-operand sort — the fused
+  sample+assign stage (GLT_FUSED_HOP).
+
+  The committed TPU trace (benchmarks/tpu_runs/profile_sampler_tpu.json)
+  puts the hop-2 assign at 41.1 ms against 15.3 ms of sampling: the
+  dedup stage is the profiled bottleneck the reference solves with one
+  fused CUDA kernel (csrc/cuda/random_sampler.cu:59-109 samples and
+  emits in a single launch). :func:`sorted_hop_dedup` pays TWO wide
+  multi-operand sorts per hop (5-8 operands over [C+M]); this variant
+  pays one narrow one, by relaxing one property nothing downstream
+  relies on: NEW ids get labels ``count..count+n-1`` in within-hop
+  VALUE order instead of first-occurrence slot order. Seen ids keep
+  their labels exactly; counts, masks, seed handling (callers keep the
+  exact path for the seed hop) and the label<->node bijection are
+  unchanged, so edges map to the same global-id multiset.
+
+  How: sort (id, labkey, pos) with 2 keys — a seen entry's label is
+  < _BIG so it heads its run and wins via a segmented fill-forward;
+  new runs are ranked by one prefix scan; results return to SLOT order
+  with a single packed scatter (labels + new-head bit in one int32),
+  so every per-element output below is aligned to the caller's flat
+  sample buffers and edge payloads never ride a sort at all.
+
+  Returns dict with (all [M], slot order):
+    labels3   : compact labels, -1 at ~valid
+    new_head3 : True at exactly one slot per newly-seen id
+    u_ids2 / u_labs2 : [C+M] updated append-form seen-set
+    count2 / new_count : scalars
+  """
+  c = u_ids.shape[0]
+  m = ids.shape[0]
+  big = _BIG
+  x = jnp.where(valid, ids.astype(jnp.int32), big)
+  cat_id = jnp.concatenate([u_ids, x])
+  cat_labkey = jnp.concatenate([u_labs, jnp.full((m,), big, jnp.int32)])
+  cat_pos = jnp.concatenate([jnp.full((c,), -1, jnp.int32),
+                             jnp.arange(m, dtype=jnp.int32)])
+  sid, slabkey, spos = jax.lax.sort([cat_id, cat_labkey, cat_pos],
+                                    num_keys=2)
+  hd = jnp.concatenate([jnp.ones((1,), bool), sid[1:] != sid[:-1]])
+  (run_lab,) = _fill_forward(hd, slabkey)
+  ok = sid != big
+  is_new = (run_lab == big) & ok
+  new_head = hd & is_new
+  from .scan import cumsum_i32
+  rank = cumsum_i32(new_head.astype(jnp.int32))
+  labels_all = jnp.where(is_new, count + rank - 1,
+                         jnp.where(ok, run_lab, -1))
+  # pack (label, new_head) into one int32: labels fit in 31 bits and
+  # label == -1 implies new_head is False, so -1 packs to -2 (>> 1
+  # recovers it; & 1 reads 0). One scatter instead of two.
+  packed = labels_all * 2 + new_head.astype(jnp.int32)
+  # slot elements carry pos >= 0 (a new run is headed by a slot
+  # element); seen-set entries route to the sink row m
+  buf = jnp.full((m + 1,), -2, jnp.int32).at[
+      jnp.where(spos >= 0, spos, m)].set(
+      jnp.where(spos >= 0, packed, -2))
+  packed_slot = buf[:m]
+  labels3 = packed_slot >> 1
+  new_head3 = (packed_slot & 1) == 1
+  new_count = rank[-1] if m + c > 0 else jnp.zeros((), jnp.int32)
+  u_ids2 = jnp.concatenate([u_ids, jnp.where(new_head3, x, big)])
+  u_labs2 = jnp.concatenate([u_labs, jnp.where(new_head3, labels3,
+                                               big)])
+  return dict(labels3=labels3, new_head3=new_head3,
+              u_ids2=u_ids2, u_labs2=u_labs2,
+              count2=count + new_count, new_count=new_count)
+
+
 def sorted_nodes_by_label(u_ids: jax.Array, u_labs: jax.Array,
                           count: jax.Array, budget: int) -> jax.Array:
   """Materialize the dense node list (position = label) from the

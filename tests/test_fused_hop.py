@@ -1,0 +1,255 @@
+"""GLT_FUSED_HOP: the single-sort fused sample+assign stage.
+
+The committed TPU trace (benchmarks/tpu_runs/profile_sampler_tpu.json)
+showed the per-hop dedup/assign at 41 ms against 15 ms of sampling — the
+stage the reference fuses into one CUDA kernel
+(csrc/cuda/random_sampler.cu:59-109). The fused engine replaces the two
+wide multi-operand sorts of sorted_hop_dedup with one narrow sort plus a
+packed scatter; the one observable change is that NEW nodes within a hop
+get labels in value order rather than first-occurrence slot order (the
+seed hop keeps the exact path). These tests pin:
+  * exact parity of every scalar/count surface and of batch/seed_labels
+    against BOTH existing engines under exhaustive fanouts,
+  * per-hop edge multisets in GLOBAL-ID space (labels map through the
+    node list, so value-order labels must describe the same subgraph),
+  * random-graph invariants (valid sample, bijection, -1 padding),
+  * the hetero loop and the SPMD train step on the virtual mesh.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from glt_tpu.data import Topology
+from glt_tpu.ops.pipeline import edge_hop_offsets, multihop_sample
+from glt_tpu.ops.sample import sample_neighbors
+from glt_tpu.ops.unique import sorted_hop_dedup_fused
+
+from fixtures import ring_edges
+
+
+@pytest.fixture(scope='module')
+def mesh():
+  from glt_tpu.parallel import make_mesh
+  return make_mesh(8)
+
+
+def _run(engine, fused, seeds, n_valid, fanouts, num_nodes, indptr,
+         indices, key, monkeypatch, with_edge=False):
+  from glt_tpu.ops.unique import dense_make_tables
+  monkeypatch.setenv('GLT_DEDUP', engine)
+  monkeypatch.setenv('GLT_FUSED_HOP', '1' if fused else '0')
+  one_hop = lambda ids, f, k, m: sample_neighbors(
+      indptr, indices, ids, f, k, seed_mask=m,
+      edge_ids=jnp.arange(indices.shape[0], dtype=jnp.int32))
+  table, scratch = dense_make_tables(num_nodes)
+  out, _, _ = multihop_sample(one_hop, seeds, n_valid, fanouts, key,
+                              table, scratch, with_edge=with_edge)
+  return jax.tree.map(np.asarray, out)
+
+
+def _edge_multiset_gid(out, batch_size, fanouts):
+  """Per-hop (parent_gid, child_gid, eid) multisets: label-order
+  independent."""
+  offs = edge_hop_offsets(batch_size, fanouts)
+  nodes = out['node']
+  per_hop = []
+  for h in range(len(fanouts)):
+    s, e = offs[h], offs[h + 1]
+    m = out['edge_mask'][s:e].astype(bool)
+    child = nodes[out['row'][s:e][m]]
+    parent = nodes[out['col'][s:e][m]]
+    eid = out['edge'][s:e][m]
+    per_hop.append(sorted(zip(parent.tolist(), child.tolist(),
+                              eid.tolist())))
+  return per_hop
+
+
+@pytest.mark.parametrize('fanouts', [(2,), (3, 2), (2, 2, 2)])
+def test_fused_matches_both_engines(monkeypatch, fanouts):
+  # ring graph, deg 2: fanouts are exhaustive, so all engines see the
+  # same neighbor sets and every count surface must match exactly
+  n = 24
+  rows = np.repeat(np.arange(n), 2)
+  cols = np.stack([(np.arange(n) + 1) % n, (np.arange(n) + 2) % n],
+                  1).reshape(-1)
+  t = Topology(edge_index=np.stack([rows, cols]), num_nodes=n)
+  indptr = jnp.asarray(t.indptr.astype(np.int32))
+  indices = jnp.asarray(t.indices)
+  seeds = jnp.array([5, 0, 5, 17], jnp.int32)       # dup seed included
+  nv = jnp.asarray(3)                                # one masked slot
+  key = jax.random.key(0)
+  bs = seeds.shape[0]
+
+  f = _run('sort', True, seeds, nv, fanouts, n, indptr, indices, key,
+           monkeypatch, with_edge=True)
+  for engine in ('table', 'sort'):
+    a = _run(engine, False, seeds, nv, fanouts, n, indptr, indices,
+             key, monkeypatch, with_edge=True)
+    assert int(a['node_count']) == int(f['node_count'])
+    assert int(a['seed_count']) == int(f['seed_count'])
+    sc = int(f['seed_count'])
+    # seed hop stays on the exact path: labels and batch prefix are
+    # bit-identical; past seed_count the node list is value-ordered
+    # within each hop, so compare as sets there
+    np.testing.assert_array_equal(a['batch'][:sc], f['batch'][:sc])
+    np.testing.assert_array_equal(a['seed_labels'], f['seed_labels'])
+    np.testing.assert_array_equal(a['num_sampled_nodes'],
+                                  f['num_sampled_nodes'])
+    np.testing.assert_array_equal(a['num_sampled_edges'],
+                                  f['num_sampled_edges'])
+    cnt = int(f['node_count'])
+    assert set(a['node'][:cnt].tolist()) == set(f['node'][:cnt].tolist())
+    assert (f['node'][cnt:] == -1).all()
+    assert _edge_multiset_gid(a, bs, fanouts) == \
+        _edge_multiset_gid(f, bs, fanouts)
+
+
+def test_fused_random_graph_invariants(monkeypatch):
+  # non-exhaustive fanouts: the fused draw differs from the unfused one
+  # (frontier lane order feeds the RNG) but must still be a VALID sample
+  rng = np.random.default_rng(3)
+  n, e = 500, 4000
+  src = rng.integers(0, n, e)
+  dst = rng.integers(0, n, e)
+  t = Topology(edge_index=np.stack([src, dst]), num_nodes=n)
+  indptr = jnp.asarray(t.indptr.astype(np.int32))
+  indices = jnp.asarray(t.indices)
+  fanouts = (4, 3)
+  seeds = jnp.asarray(rng.integers(0, n, 32).astype(np.int32))
+  out = _run('sort', True, seeds, jnp.asarray(32), fanouts, n, indptr,
+             indices, jax.random.key(1), monkeypatch)
+
+  count = int(out['node_count'])
+  nodes = out['node']
+  assert len(set(nodes[:count].tolist())) == count
+  assert (nodes[count:] == -1).all()
+  m = out['edge_mask'].astype(bool)
+  row_l = out['row'][m]
+  col_l = out['col'][m]
+  assert (row_l >= 0).all() and (row_l < count).all()
+  assert (col_l >= 0).all() and (col_l < count).all()
+  ip = np.asarray(t.indptr)
+  ix = np.asarray(t.indices)
+  for child, parent in zip(row_l[:200], col_l[:200]):
+    p, ch = nodes[parent], nodes[child]
+    assert ch in ix[ip[p]:ip[p + 1]]
+  assert out['num_sampled_nodes'].sum() == count
+  sl = out['seed_labels']
+  assert (sl >= 0).all() and (sl < int(out['seed_count'])).all()
+  np.testing.assert_array_equal(nodes[sl], np.asarray(seeds))
+
+
+def test_fused_hop_dedup_unit():
+  # hand-checked: seen ids keep labels; NEW ids rank in VALUE order
+  # (3 < 9 -> 3 gets label 2, 9 gets label 3); invalid slots -> -1
+  u_ids = jnp.array([40, 7], jnp.int32)
+  u_labs = jnp.array([0, 1], jnp.int32)
+  ids = jnp.array([9, 7, 9, 3, 40, 9], jnp.int32)
+  valid = jnp.array([True, True, True, True, True, False])
+  d = sorted_hop_dedup_fused(u_ids, u_labs, jnp.asarray(2, jnp.int32),
+                             ids, valid)
+  labels = np.asarray(d['labels3'])
+  np.testing.assert_array_equal(labels, [3, 1, 3, 2, 0, -1])
+  assert int(d['new_count']) == 2 and int(d['count2']) == 4
+  # exactly one new-head per new id, at a slot holding that id
+  nh = np.asarray(d['new_head3'])
+  assert nh.sum() == 2
+  assert sorted(np.asarray(ids)[nh].tolist()) == [3, 9]
+  # append-form seen-set reconstructs the dense node list
+  from glt_tpu.ops.unique import sorted_nodes_by_label
+  nodes = sorted_nodes_by_label(d['u_ids2'], d['u_labs2'], d['count2'],
+                                6)
+  np.testing.assert_array_equal(np.asarray(nodes),
+                                [40, 7, 3, 9, -1, -1])
+
+
+@pytest.mark.parametrize('fanouts', [[2], [2, 2]])
+def test_fused_hetero_matches_table(monkeypatch, fanouts):
+  from fixtures import hetero_ring_dataset
+  from glt_tpu.sampler import NeighborSampler, NodeSamplerInput
+  ds = hetero_ring_dataset(num_users=10, num_items=20)
+  u2i = ('user', 'u2i', 'item')
+  i2i = ('item', 'i2i', 'item')
+  seeds = NodeSamplerInput(np.array([3, 7, 3, 9]), 'user')
+  key = jax.random.key(5)
+
+  outs = {}
+  for engine, fused in (('table', False), ('sort', True)):
+    monkeypatch.setenv('GLT_DEDUP', engine)
+    monkeypatch.setenv('GLT_FUSED_HOP', '1' if fused else '0')
+    s = NeighborSampler(ds.graph, {u2i: fanouts, i2i: fanouts},
+                        with_edge=True, seed=4)
+    outs[engine] = s.sample_from_nodes(seeds, key=key)
+  a, f = outs['table'], outs['sort']
+
+  for t in ('user', 'item'):
+    cnt = int(a.node_count[t])
+    assert cnt == int(f.node_count[t])
+    na, nf = np.asarray(a.node[t]), np.asarray(f.node[t])
+    assert set(na[:cnt].tolist()) == set(nf[:cnt].tolist())
+    assert (nf[cnt:] == -1).all()
+    np.testing.assert_array_equal(np.asarray(a.num_sampled_nodes[t]),
+                                  np.asarray(f.num_sampled_nodes[t]))
+  for t in a.metadata['seed_labels']:
+    np.testing.assert_array_equal(
+        np.asarray(a.metadata['seed_labels'][t]),
+        np.asarray(f.metadata['seed_labels'][t]))
+  assert set(a.row) == set(f.row)
+  for e in a.row:
+    np.testing.assert_array_equal(np.asarray(a.num_sampled_edges[e]),
+                                  np.asarray(f.num_sampled_edges[e]))
+    offs = a.metadata['edge_hop_offsets'][e]
+    assert offs == f.metadata['edge_hop_offsets'][e]
+    col_t = e[2]
+    for h in range(len(offs) - 1):
+      lo, hi = offs[h], offs[h + 1]
+      def hop_gid_tuples(o, row_t_nodes, col_t_nodes):
+        m = np.asarray(o.edge_mask[e])[lo:hi].astype(bool)
+        parent = row_t_nodes[np.asarray(o.row[e])[lo:hi][m]]
+        child = col_t_nodes[np.asarray(o.col[e])[lo:hi][m]]
+        eid = np.asarray(o.edge[e])[lo:hi][m]
+        return sorted(zip(parent.tolist(), child.tolist(),
+                          eid.tolist()))
+      # row buffer holds PARENT labels (expand-from type), col holds
+      # CHILD labels (neighbor type) in traversal orientation
+      row_t = e[0]
+      assert hop_gid_tuples(a, np.asarray(a.node[row_t]),
+                            np.asarray(a.node[col_t])) == \
+          hop_gid_tuples(f, np.asarray(f.node[row_t]),
+                         np.asarray(f.node[col_t]))
+
+
+def test_fused_spmd_train_step_learns(monkeypatch, mesh):
+  # the fused assign inside the full SPMD training step on the 8-device
+  # virtual mesh: compiles, runs, learns (VERDICT r4 next #2's
+  # virtual-mesh validation)
+  import optax
+  from glt_tpu.data import Dataset
+  from glt_tpu.models import GraphSAGE
+  from glt_tpu.parallel import ShardedFeature, SPMDSageTrainStep
+  monkeypatch.setenv('GLT_DEDUP', 'sort')
+  monkeypatch.setenv('GLT_FUSED_HOP', '1')
+  n = 40
+  rows, cols, _ = ring_edges(n)
+  ds = Dataset(edge_dir='out')
+  ds.init_graph(edge_index=np.stack([rows, cols]), num_nodes=n)
+  model = GraphSAGE(hidden_features=16, out_features=4, num_layers=2)
+  tx = optax.adam(1e-2)
+  sf = ShardedFeature(np.eye(n, dtype=np.float32), mesh)
+  step = SPMDSageTrainStep(mesh, model, tx, ds.get_graph(), sf,
+                           (np.arange(n) % 4).astype(np.int32),
+                           fanouts=[2, 2], batch_size_per_device=4)
+  params = step.init_params(jax.random.key(0))
+  opt_state = jax.device_put(
+      tx.init(params),
+      jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()))
+  rng = np.random.default_rng(0)
+  losses = []
+  for it in range(60):
+    seeds = rng.permutation(n)[:32]
+    keys = jax.random.split(jax.random.key(it), 8)
+    params, opt_state, loss = step(
+        params, opt_state, seeds, np.full(8, 4), keys)
+    losses.append(float(np.asarray(loss)[0]))
+  assert losses[-1] < 0.25, f'did not learn: {losses[::10]}'
