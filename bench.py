@@ -69,10 +69,8 @@ def _emit(value, vs_baseline, **extra):
 def run_worker():
   import numpy as np
   import jax
-  # The axon plugin ignores JAX_PLATFORMS; the config API is honored.
-  platform = os.environ.get('GLT_BENCH_PLATFORM')
-  if platform:
-    jax.config.update('jax_platforms', platform)
+  from glt_tpu.utils.backend import force_backend
+  force_backend()  # axon plugin ignores JAX_PLATFORMS; config API only
   jax.config.update('jax_compilation_cache_dir', _CACHE_DIR)
   jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
   import jax.numpy as jnp
@@ -151,9 +149,8 @@ def run_probe():
   A wedged axon tunnel hangs here — the supervisor's short timeout turns
   that hang into a fast, cheap verdict."""
   import jax
-  platform = os.environ.get('GLT_BENCH_PLATFORM')
-  if platform:
-    jax.config.update('jax_platforms', platform)
+  from glt_tpu.utils.backend import force_backend
+  force_backend()
   dev = jax.devices()[0]
   print(f'probe-ok {dev.platform} {dev.device_kind}')
 

@@ -67,7 +67,8 @@ def main():
         f' --xla_force_host_platform_device_count={args.num_devices}')
   import jax
   if args.cpu_mesh:
-    jax.config.update('jax_platforms', 'cpu')
+    from glt_tpu.utils.backend import force_backend
+    force_backend('cpu')
   jax.config.update('jax_compilation_cache_dir', _CACHE_DIR)
   import jax.numpy as jnp
   from glt_tpu.parallel import make_mesh

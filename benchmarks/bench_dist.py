@@ -70,8 +70,8 @@ def main():
       'XLA_FLAGS',
       f'--xla_force_host_platform_device_count={max(sizes)}')
   import jax
-  if os.environ.get('GLT_BENCH_PLATFORM'):
-    jax.config.update('jax_platforms', os.environ['GLT_BENCH_PLATFORM'])
+  from glt_tpu.utils.backend import force_backend
+  force_backend()
   jax.config.update('jax_compilation_cache_dir', _CACHE_DIR)
   from glt_tpu.partition import RandomPartitioner
 

@@ -59,8 +59,8 @@ def main():
   args = ap.parse_args()
 
   import jax
-  if os.environ.get('GLT_BENCH_PLATFORM'):
-    jax.config.update('jax_platforms', os.environ['GLT_BENCH_PLATFORM'])
+  from glt_tpu.utils.backend import force_backend
+  force_backend()
   if cpu and args.num_devices:
     os.environ['XLA_FLAGS'] = (
         os.environ.get('XLA_FLAGS', '') +

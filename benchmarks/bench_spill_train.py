@@ -58,8 +58,8 @@ def main():
   args = ap.parse_args()
 
   import jax
-  if os.environ.get('GLT_BENCH_PLATFORM'):
-    jax.config.update('jax_platforms', os.environ['GLT_BENCH_PLATFORM'])
+  from glt_tpu.utils.backend import force_backend
+  force_backend()
   jax.config.update('jax_compilation_cache_dir', _CACHE_DIR)
   jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
   import jax.numpy as jnp

@@ -6,14 +6,11 @@ from __future__ import annotations
 
 import os
 
-if os.environ.get('GLT_PLATFORM'):
-  # honor GLT_PLATFORM=cpu even where the TPU plugin overrides
-  # JAX_PLATFORMS (must run before backend init)
-  import jax
-  try:
-    jax.config.update('jax_platforms', os.environ['GLT_PLATFORM'])
-  except Exception:
-    pass
+from glt_tpu.utils.backend import force_backend
+
+# honor GLT_PLATFORM/GLT_BENCH_PLATFORM even where the TPU plugin
+# overrides JAX_PLATFORMS (must run before backend init)
+force_backend()
 
 import numpy as np
 

@@ -34,7 +34,8 @@ def main():
         f' --xla_force_host_platform_device_count={args.num_devices}')
   import jax
   if args.cpu_mesh:
-    jax.config.update('jax_platforms', 'cpu')
+    from glt_tpu.utils.backend import force_backend
+    force_backend('cpu')
   import jax.numpy as jnp
   import numpy as np
   import optax

@@ -23,10 +23,8 @@ def build_dataset():
 
 def run_server(rank, num_servers, port):
   import jax
-  try:
-    jax.config.update('jax_platforms', 'cpu')
-  except Exception:
-    pass
+  from glt_tpu.utils.backend import force_backend
+  force_backend('cpu')
   from glt_tpu.distributed import init_server, wait_and_shutdown_server
   init_server(num_servers=num_servers, num_clients=1, server_rank=rank,
               dataset=build_dataset(), master_port=port,

@@ -15,10 +15,8 @@ import numpy as np
 
 def feature_worker(chan_req, chan_resp):
   import jax
-  try:
-    jax.config.update('jax_platforms', 'cpu')
-  except Exception:
-    pass
+  from glt_tpu.utils.backend import force_backend
+  force_backend('cpu')
   from glt_tpu.data import Feature
   rng = np.random.default_rng(0)
   feats = rng.normal(size=(1000, 16)).astype(np.float32)

@@ -137,7 +137,8 @@ def main():
         f' --xla_force_host_platform_device_count={per_proc}')
   import jax
   if args.cpu_mesh:
-    jax.config.update('jax_platforms', 'cpu')
+    from glt_tpu.utils.backend import force_backend
+    force_backend('cpu')
   if multihost:
     from glt_tpu.parallel.multihost import initialize
     initialize(coordinator_address=args.coordinator,

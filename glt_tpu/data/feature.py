@@ -126,10 +126,15 @@ class Feature:
     self._cold_count = int(self._cold.shape[0])
     if offload_requested(self._host_offload, self._cold_count > 0) \
         and self._cold_count:
+      # cast in numpy and device_put the numpy array STRAIGHT into host
+      # memory: jnp.asarray would first materialize the whole cold block
+      # on the default device, which is exactly the HBM allocation a
+      # beyond-HBM cold block cannot afford (the sharded builders in
+      # parallel/dist_feature.py already follow this rule)
+      cold_np = self._cold.astype(
+          np.dtype(jnp.dtype(self.dtype)), copy=False)
       self.cold_array = maybe_pin_host(
-          lambda: jax.device_put(
-              jnp.asarray(self._cold, dtype=self.dtype),
-              jax.memory.Space.Host),
+          lambda: jax.device_put(cold_np, jax.memory.Space.Host),
           self._host_offload)
       if self.cold_array is not None:
         # the pinned block IS the cold copy; keeping the numpy view

@@ -89,6 +89,15 @@ class Graph:
     self._edge_weights = put(self.topo.edge_weights)
     self._initialized = True
 
+  # NOTE on edge-array length: after any windowed sample has called
+  # ``window_arrays``, the edge arrays below may carry a sentinel-padded
+  # tail (indices/edge_ids = -1, edge_weights = 0.0) — the padded copy
+  # supersedes the original so only ONE resident copy exists (see
+  # window_arrays). The LOGICAL edge list is always ``[:num_edges]``;
+  # ``shape[0] == num_edges`` is NOT an invariant of these properties.
+  # Kernels are insensitive (gathers clip into the logical prefix);
+  # code iterating a full array must slice to ``num_edges`` first.
+
   @property
   def indptr(self):
     self.lazy_init()
@@ -96,16 +105,22 @@ class Graph:
 
   @property
   def indices(self):
+    """Neighbor ids; may be sentinel-padded past ``num_edges`` (see
+    class note above)."""
     self.lazy_init()
     return self._indices
 
   @property
   def edge_ids(self):
+    """Edge ids; may be sentinel-padded past ``num_edges`` (see class
+    note above)."""
     self.lazy_init()
     return self._edge_ids
 
   @property
   def edge_weights(self):
+    """Edge weights; may be sentinel-padded past ``num_edges`` (see
+    class note above)."""
     self.lazy_init()
     return self._edge_weights
 

@@ -63,10 +63,8 @@ def _sampling_worker_loop(rank: int, num_workers: int,
   # the TPU chip belongs to the trainer; workers sample on host CPU
   os.environ.setdefault('XLA_FLAGS', '')
   import jax
-  try:
-    jax.config.update('jax_platforms', 'cpu')
-  except Exception:
-    pass
+  from glt_tpu.utils.backend import force_backend
+  force_backend('cpu')
   from ..sampler import NeighborSampler
 
   ds = dataset_builder()

@@ -17,7 +17,7 @@ import socket
 import struct
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
-from typing import Any, Callable, Dict, List
+from typing import Any, Callable, Dict, List, Optional
 
 _HDR = struct.Struct('<Q')
 
@@ -47,7 +47,20 @@ class RpcServer:
   (the RpcCalleeBase/rpc_register pattern, reference rpc.py:419-473)."""
 
   def __init__(self, host: str = '127.0.0.1', port: int = 0,
-               auto_start: bool = True):
+               auto_start: bool = True,
+               resolve_timeout: Optional[float] = None):
+    """``resolve_timeout``: how long an incoming request waits for a
+    not-yet-registered callee before KeyError. Defaults to 30 s under
+    ``auto_start=True`` (where the discovery/registration race is real
+    — peers can learn the address before user code finishes
+    registering) and 1 s otherwise (callers of auto_start=False
+    register everything before start(), so an unknown name is almost
+    certainly a typo and should fail fast instead of stalling the
+    connection's serve loop — and every request queued behind it — for
+    30 s per call)."""
+    self._resolve_timeout = (resolve_timeout if resolve_timeout
+                             is not None else (30.0 if auto_start
+                                               else 1.0))
     self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     self._sock.bind((host, port))
@@ -83,11 +96,16 @@ class RpcServer:
       self._callees[name] = fn
       self._reg_cond.notify_all()
 
-  def _resolve(self, name: str, timeout: float = 30.0) -> Callable:
+  def _resolve(self, name: str,
+               timeout: Optional[float] = None) -> Callable:
     """Look up a callee, WAITING briefly for late registration — peers
     discover this server's address before user code finishes
     registering (the KeyError('push_edges') race the start() docstring
-    documents); a bounded wait turns that race into latency."""
+    documents); a bounded wait turns that race into latency. The wait
+    is ``resolve_timeout`` (see __init__): long only under auto_start,
+    so a typo'd name fails fast on pre-registered servers."""
+    if timeout is None:
+      timeout = self._resolve_timeout
     deadline = None
     with self._reg_cond:
       while name not in self._callees:

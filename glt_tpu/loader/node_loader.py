@@ -85,8 +85,18 @@ class NodeLoader:
     def host_phase(f):
       if getattr(f, 'fully_device_resident', True):
         return False
-      f.lazy_init()  # offload is decided at placement time
-      return f.cold_array is None
+      if getattr(f, '_initialized', False):
+        return f.cold_array is None  # placement happened: exact answer
+      # NOT yet placed: decide from the offload INTENT instead of
+      # forcing device placement at loader construction (which would
+      # change placement ordering for callers that build loaders before
+      # arranging devices/memory — ADVICE r4). If an auto-mode offload
+      # later fails at placement (platform without memory kinds) the
+      # store falls back to a host phase we did not predict; that costs
+      # only the missing prefetch overlap, never correctness.
+      from ..utils.offload import offload_requested
+      return not offload_requested(getattr(f, '_host_offload', None),
+                                   True)
     return any(host_phase(f) for f in stores)
 
   def __len__(self):

@@ -6,22 +6,23 @@ localhost rpc, we ask XLA for 8 host devices so sharding/collective code
 paths execute exactly as they would on a TPU slice.
 """
 import os
+import sys
 
-# Must run before jax initializes its backend. NOTE: the JAX_PLATFORMS env
-# var is overridden by the axon TPU plugin in this image — the config API
-# is authoritative, so force CPU through it.
-_flags = os.environ.get('XLA_FLAGS', '')
-if 'xla_force_host_platform_device_count' not in _flags:
-  os.environ['XLA_FLAGS'] = (
-      _flags + ' --xla_force_host_platform_device_count=8').strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 # the suite's offload assertions assume the documented default (auto-on
 # when spilled); an ambient GLT_HOST_OFFLOAD=0 opt-out must not leak in
 os.environ.pop('GLT_HOST_OFFLOAD', None)
 
-import jax
+# Must run before jax initializes its backend (the axon TPU plugin
+# overrides JAX_PLATFORMS; the config API is authoritative) — the
+# shared guard owns that rule: glt_tpu/utils/backend.py
+from glt_tpu.utils.backend import force_backend
 
-jax.config.update('jax_platforms', 'cpu')
+force_backend('cpu', host_devices=8)
+
+import jax
 
 import numpy as np
 import pytest

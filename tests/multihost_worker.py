@@ -11,7 +11,8 @@ def main():
   port = sys.argv[3]
   os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=2'
   import jax
-  jax.config.update('jax_platforms', 'cpu')
+  from glt_tpu.utils.backend import force_backend
+  force_backend('cpu')
   from glt_tpu.parallel.multihost import initialize
   initialize(coordinator_address=f'127.0.0.1:{port}', num_processes=2,
              process_id=rank)

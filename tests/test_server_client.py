@@ -155,10 +155,8 @@ def _server_proc(rank, port, ready, done):
   import sys, os
   sys.path.insert(0, os.path.dirname(__file__))
   import jax
-  try:
-    jax.config.update('jax_platforms', 'cpu')
-  except Exception:
-    pass
+  from glt_tpu.utils.backend import force_backend
+  force_backend('cpu')
   from glt_tpu.distributed import init_server, wait_and_shutdown_server
   ds = build_ring_dataset()
   init_server(num_servers=2, num_clients=1, server_rank=rank,
