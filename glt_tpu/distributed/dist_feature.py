@@ -19,7 +19,6 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.collectives import all_to_all, bucket_by_owner, unbucket
-from ..parallel.dist_feature import _more_rounds_global, overflow_lanes
 from ..utils import as_numpy
 from .dist_graph import _pb_dense
 
@@ -85,8 +84,6 @@ class DistFeature:
       hot = self.hot_counts[p]
       pb_dense = _pb_dense(feat_pb[p], self.num_ids)
       pbs_l.append(pb_dense)
-      if self.bucket_cap:
-        self._host_pb[p] = pb_dense
       if self._spill:
         # every local partition keeps its host routing book: a
         # fully-resident requester can still route a lane to a spilled
@@ -131,12 +128,10 @@ class DistFeature:
       if self.cold_array is not None:
         # host-phase state (and the cold_get rpc surface) is unused
         # when cold rows are served in-program; keeping the numpy
-        # blocks would double the cold footprint in host RAM. The
-        # routing books stay only for the bucket_cap drain replay.
+        # blocks would double the cold footprint in host RAM
         self._host_cold = {}
         self._host_id2index = {}
-        if not self.bucket_cap:
-          self._host_pb = {}
+        self._host_pb = {}
       self._build_lookup_fn()
 
   def _finish_init(self, mesh: Mesh, axis: str, num_ids: int,
@@ -173,14 +168,12 @@ class DistFeature:
     self._host_pb = {}        # part -> np [N] requester routing book
     self._cold_fetcher = cold_fetcher
     # bucket_cap < B caps each per-peer request bucket (see
-    # parallel.ShardedFeature.bucket_cap); the drain loop in lookup()
-    # replays the routing with _host_pb, which __init__ retains
-    # whenever bucket_cap is set
+    # parallel.ShardedFeature.bucket_cap); lookup_local drains the
+    # overflow in-program (round loop + pmax round count)
     self.bucket_cap = int(bucket_cap)
     # the cap is baked into the shard_map trace on first lookup; a later
-    # mutation would desync the host drain replay from the compiled
-    # device routing (silently double-serving lanes) — record the cap
-    # actually traced and refuse mismatched lookups (see lookup())
+    # mutation would silently keep routing with the old cap — record
+    # the cap actually traced and refuse mismatched lookups (lookup())
     self._traced_cap = None
     self._hot_counts_dev = jnp.asarray(self.hot_counts)
     # stacked pinned-host cold blocks [P, C_max, D]; builders that
@@ -228,134 +221,129 @@ class DistFeature:
     by lookup()'s host phase. With ``cold_shard`` (this device's
     pinned-host [C_max, D] block), cold lanes are instead served
     in-program by a compute_on('device_host') gather and the return is
-    the plain [B, D] — the form fused train steps consume."""
+    the plain [B, D] — the form fused train steps consume.
+
+    With ``bucket_cap`` set the overflow drain runs IN-PROGRAM (round k
+    ships bucket ranks [k*cap, (k+1)*cap); the round count is the
+    mesh-wide pmax of bucket occupancy over the cap) — no host replay
+    of the routing, no retained books, and fused train steps can use
+    capped stores (see parallel.collectives.drain_rounds)."""
+    from ..parallel.collectives import bucket_payload, drain_rounds
     ax = axis_name or self.axis
     n = self.num_partitions
+    b = ids.shape[0]
     owner = jnp.take(pb, jnp.clip(ids, 0, self.num_ids - 1), mode='clip')
     owner = jnp.where(valid, owner, n)
-    cap = (self.bucket_cap if 0 < self.bucket_cap < ids.shape[0]
-           else 0)
-    req, meta = bucket_by_owner(ids, owner, n, capacity=cap)
-    req_in = all_to_all(req, ax)                      # [P, C]
-    flat = req_in.reshape(-1)
-    rows = jnp.take(map_shard, jnp.clip(flat, 0, self.num_ids - 1),
-                    mode='clip')
-    ok = (flat >= 0) & (rows >= 0)
-    if self._spill:
-      my_hot = jnp.take(self._hot_counts_dev, jax.lax.axis_index(ax))
-      cold = ok & (rows >= my_hot)
-      ok = ok & (rows < my_hot)
-    safe_rows = jnp.clip(rows, 0, self.hot_max - 1)
-    from ..ops.pallas_kernels import resolve_row_gather
-    gather = resolve_row_gather(self._row_gather)
-    if gather is not None:   # per-row DMA serving gather (see
-      #                        parallel.ShardedFeature.lookup_local)
-      rows_out = gather(feat_shard, safe_rows)
-    else:
-      rows_out = jnp.take(feat_shard, safe_rows, axis=0)
-    served = jnp.where(ok[:, None], rows_out, 0)
-    if not self._spill:
-      resp = all_to_all(served.reshape(n, -1, self.feature_dim), ax)
-      return unbucket(resp, meta, n)
-    if cold_shard is not None:
-      # serve the owner's spilled rows from pinned host memory without
-      # leaving the program: index arithmetic stays on device, the
-      # gather runs host-side (raw indexing — bounds ops would
-      # materialize device-space constants inside the host region)
-      from jax.experimental import compute_on
-      cold_idx = jnp.clip(rows - my_hot, 0, cold_shard.shape[0] - 1)
-      idx_h = jax.device_put(cold_idx, jax.memory.Space.Host)
-      with compute_on.compute_on('device_host'):
-        cold_out = cold_shard[idx_h]
-      cold_out = jax.device_put(cold_out, jax.memory.Space.Device)
-      served = jnp.where(cold[:, None], cold_out.astype(served.dtype),
-                         served)
-      resp = all_to_all(served.reshape(n, -1, self.feature_dim), ax)
-      return unbucket(resp, meta, n)
-    # ride the cold flag back as one extra response column so the
-    # requester learns hot/cold without holding the owner's id2index
-    payload = jnp.concatenate(
-        [served, cold[:, None].astype(served.dtype)], axis=1)
-    resp = all_to_all(payload.reshape(n, -1, self.feature_dim + 1), ax)
-    full = unbucket(resp, meta, n)
-    return full[:, :self.feature_dim], full[:, self.feature_dim] > 0
+    cap = (self.bucket_cap if 0 < self.bucket_cap < b else 0)
+    _, meta = bucket_by_owner(ids, owner, n, capacity=cap)
+    eff_cap = cap if cap else b
+    two_outputs = self._spill and cold_shard is None
+
+    def round_serve(base):
+      req = bucket_payload(ids, meta, n, fill_value=-1,
+                           capacity=eff_cap, round_offset=base)
+      req_in = all_to_all(req, ax)                      # [P, C]
+      flat = req_in.reshape(-1)
+      rows = jnp.take(map_shard, jnp.clip(flat, 0, self.num_ids - 1),
+                      mode='clip')
+      ok = (flat >= 0) & (rows >= 0)
+      if self._spill:
+        my_hot = jnp.take(self._hot_counts_dev, jax.lax.axis_index(ax))
+        cold = ok & (rows >= my_hot)
+        ok = ok & (rows < my_hot)
+      safe_rows = jnp.clip(rows, 0, self.hot_max - 1)
+      from ..ops.pallas_kernels import resolve_row_gather
+      gather = resolve_row_gather(self._row_gather)
+      if gather is not None:   # per-row DMA serving gather (see
+        #                        parallel.ShardedFeature.lookup_local)
+        rows_out = gather(feat_shard, safe_rows)
+      else:
+        rows_out = jnp.take(feat_shard, safe_rows, axis=0)
+      served = jnp.where(ok[:, None], rows_out, 0)
+      if not self._spill:
+        resp = all_to_all(served.reshape(n, -1, self.feature_dim), ax)
+        return unbucket(resp, meta, n, round_offset=base)
+      if cold_shard is not None:
+        # serve the owner's spilled rows from pinned host memory
+        # without leaving the program: index arithmetic stays on
+        # device, the gather runs host-side (raw indexing — bounds ops
+        # would materialize device-space constants inside the host
+        # region)
+        from jax.experimental import compute_on
+        cold_idx = jnp.clip(rows - my_hot, 0, cold_shard.shape[0] - 1)
+        idx_h = jax.device_put(cold_idx, jax.memory.Space.Host)
+        with compute_on.compute_on('device_host'):
+          cold_out = cold_shard[idx_h]
+        cold_out = jax.device_put(cold_out, jax.memory.Space.Device)
+        served = jnp.where(cold[:, None],
+                           cold_out.astype(served.dtype), served)
+        resp = all_to_all(served.reshape(n, -1, self.feature_dim), ax)
+        return unbucket(resp, meta, n, round_offset=base)
+      # ride the cold flag back as one extra response column so the
+      # requester learns hot/cold without holding the owner's id2index
+      payload = jnp.concatenate(
+          [served, cold[:, None].astype(served.dtype)], axis=1)
+      resp = all_to_all(payload.reshape(n, -1, self.feature_dim + 1),
+                        ax)
+      full = unbucket(resp, meta, n, round_offset=base)
+      return full[:, :self.feature_dim], full[:, self.feature_dim] > 0
+
+    if not cap:
+      return round_serve(0)
+    rounds = drain_rounds(meta, n, eff_cap, ax)
+    if two_outputs:
+      def body(state):
+        k, acc, flag = state
+        o, f = round_serve(k * eff_cap)
+        return k + 1, acc + o, flag | f
+      _, out, flag = jax.lax.while_loop(
+          lambda s: s[0] < rounds, body,
+          (jnp.zeros((), jnp.int32),
+           jnp.zeros((b, self.feature_dim), feat_shard.dtype),
+           jnp.zeros((b,), bool)))
+      return out, flag
+
+    def body(state):
+      k, acc = state
+      return k + 1, acc + round_serve(k * eff_cap)
+    _, out = jax.lax.while_loop(
+        lambda s: s[0] < rounds, body,
+        (jnp.zeros((), jnp.int32),
+         jnp.zeros((b, self.feature_dim), feat_shard.dtype)))
+    return out
 
   def lookup(self, ids, valid=None) -> jax.Array:
     """Whole-mesh lookup: ids [P * B] shard-major.
 
-    With ``bucket_cap`` set, requests a capped bucket could not carry
-    are drained through the SAME compiled program in follow-up rounds
-    (deterministic routing replayed on host with the retained books);
-    with host spill, flagged cold lanes are resolved from the host
-    shards at the end. Both compose: a lane that overflowed in round k
-    and turns out cold in round k+1 still resolves exactly once."""
+    Capped stores drain their overflow inside the compiled program
+    (lookup_local runs the round loop on device) — one call regardless
+    of skew. With host spill, flagged cold lanes are resolved from the
+    host shards at the end; both compose: a lane that overflowed in
+    round k and turns out cold in round k+1 still resolves exactly
+    once."""
     if self._traced_cap is None:
       self._traced_cap = self.bucket_cap
     elif self.bucket_cap != self._traced_cap:
       raise RuntimeError(
           f'bucket_cap changed from {self._traced_cap} to '
           f'{self.bucket_cap} after the first lookup compiled it in; '
-          'the cached device routing would no longer match the host '
-          'drain replay (double-serving lanes). Set bucket_cap before '
-          'the first lookup, or build a new store.')
+          'the cached program would keep routing with the old cap. '
+          'Set bucket_cap before the first lookup, or build a new '
+          'store.')
     ids_np = as_numpy(ids).astype(np.int64)
     ids = jnp.asarray(ids_np, jnp.int32)
     if valid is None:
       valid_np = np.ones(ids_np.shape, bool)
     else:
       valid_np = as_numpy(valid).astype(bool)
-    n, b = self.num_partitions, ids_np.shape[0] // self.num_partitions
-    capped = 0 < self.bucket_cap < b
-    pending = valid_np
-    out = None
-    cold_lanes = []
-    offloaded = self.cold_array is not None
-    while True:
-      res = self._call_lookup_fn(ids, jnp.asarray(pending))
-      if self._spill and not offloaded:
-        r, flag = res
-        cold_lanes.append(_flag_lanes(flag))
-      else:
-        r = res
-      out = r if out is None else out + r
-      if not capped:
-        break
-      over = self._overflow_replay(ids_np, pending, n, b)
-      if not _more_rounds_global(bool(over.any())):
-        break
-      pending = over
-    if self._spill:
-      lanes = np.concatenate(cold_lanes) if cold_lanes else \
-          np.zeros(0, np.int64)
+    res = self._call_lookup_fn(ids, jnp.asarray(valid_np))
+    if self._spill and self.cold_array is None:
+      out, flag = res
+      lanes = _flag_lanes(flag)
       if lanes.size:
         out = self._resolve_cold(out, lanes, ids_np)
-    return out
-
-  def _overflow_replay(self, ids_np, pending, n, b) -> np.ndarray:
-    """Replay this round's routing for the lanes of partitions whose
-    books live in this process; OR across processes so every process
-    agrees on the next round's pending set."""
-    local = [i for i, dev in enumerate(self.mesh.devices.reshape(-1))
-             if dev.process_index == jax.process_index()]
-    missing = [d for d in local if d not in self._host_pb]
-    if missing:
-      raise RuntimeError(
-          f'bucket_cap drain needs the host routing books of local '
-          f'partitions {missing} but they were not retained — pass '
-          'bucket_cap to the constructor/builder (setting it after '
-          'construction would silently leave overflow lanes at zero)')
-    over = np.zeros(ids_np.shape[0], bool)
-    for d, book in self._host_pb.items():
-      sl = slice(d * b, (d + 1) * b)
-      owner_blk = np.where(
-          pending[sl],
-          book[np.clip(ids_np[sl], 0, self.num_ids - 1)], n)
-      over[sl] = overflow_lanes(owner_blk, n, b, self.bucket_cap)
-    if jax.process_count() > 1:
-      from jax.experimental import multihost_utils
-      over = np.asarray(multihost_utils.process_allgather(
-          jnp.asarray(over))).any(axis=0)
-    return over
+      return out
+    return res
 
   # -- host spill resolution ---------------------------------------------
 
@@ -593,7 +581,7 @@ def dist_feature_from_partitions_multihost(mesh, root_dir: str,
     if dtype is not None:
       feats = feats.astype(dtype)
     pb_dense = _pb_dense(pb2, num_ids)
-    if spill or bucket_cap:
+    if spill:
       store._host_pb[p] = pb_dense
       hot = int(hot_counts[p])
       if hot < feats.shape[0]:

@@ -53,18 +53,36 @@ def bucket_by_owner(ids: jax.Array, owner: jax.Array, n_shards: int,
 
 
 def unbucket(resp: jax.Array, meta: BucketMeta, n_shards: int,
-             invalid_value=0) -> jax.Array:
+             invalid_value=0, round_offset=0) -> jax.Array:
   """Invert bucket_by_owner over a response [n_shards, C, ...]: returns
   [B, ...] in the original request order; dropped and over-capacity
-  slots get ``invalid_value``."""
+  slots get ``invalid_value``. ``round_offset`` (may be a traced
+  scalar) selects the drain round: only requests whose in-bucket rank
+  lies in [round_offset, round_offset + C) are decoded — the inverse of
+  the same offset passed to :func:`bucket_payload`."""
   cap = resp.shape[1]
-  ok = (meta.owner_sorted < n_shards) & (meta.pos_in_bucket < cap)
+  pos = meta.pos_in_bucket - round_offset
+  ok = (meta.owner_sorted < n_shards) & (pos >= 0) & (pos < cap)
   gathered = resp[jnp.minimum(meta.owner_sorted, n_shards - 1),
-                  jnp.minimum(meta.pos_in_bucket, cap - 1)]
+                  jnp.clip(pos, 0, cap - 1)]
   shape = (ok.shape[0],) + (1,) * (gathered.ndim - 1)
   gathered = jnp.where(ok.reshape(shape), gathered, invalid_value)
   out = jnp.zeros_like(gathered)
   return out.at[meta.order].set(gathered)
+
+
+def drain_rounds(meta: BucketMeta, n_shards: int, cap: int,
+                 axis_name: str) -> jax.Array:
+  """How many capped-exchange rounds serve every request: the max
+  per-owner bucket occupancy over the WHOLE mesh, ceil-divided by the
+  capacity. pmax makes the value identical on every device, so a
+  lax.while_loop conditioned on it keeps the collectives inside the
+  loop aligned — the drain runs entirely in-program (no host replay of
+  the bucketing, no cross-process agreement round)."""
+  counts = jnp.bincount(jnp.minimum(meta.owner_sorted, n_shards),
+                        length=n_shards + 1)[:n_shards]
+  local = (counts.max() + cap - 1) // cap
+  return jax.lax.pmax(local.astype(jnp.int32), axis_name)
 
 
 def all_to_all(x: jax.Array, axis_name: str) -> jax.Array:
@@ -76,18 +94,23 @@ def all_to_all(x: jax.Array, axis_name: str) -> jax.Array:
 
 
 def bucket_payload(values: jax.Array, meta: BucketMeta, n_shards: int,
-                   fill_value=0, capacity: int = 0) -> jax.Array:
+                   fill_value=0, capacity: int = 0,
+                   round_offset=0) -> jax.Array:
   """Pack a companion payload with the SAME ordering as an existing
   bucket_by_owner call (e.g. the col of a (row, col) pair routed by the
-  row's owner)."""
+  row's owner). ``round_offset`` (may be a traced scalar, e.g. the
+  drain-loop counter times the capacity) packs the requests ranked
+  [round_offset, round_offset + cap) within each bucket — drain round k
+  of a capped exchange packs offset k*cap."""
   b = values.shape[0]
   cap = capacity if capacity and capacity < b else b
   vals_sorted = jnp.take(values, meta.order)
-  ok = (meta.owner_sorted < n_shards) & (meta.pos_in_bucket < cap)
+  pos = meta.pos_in_bucket - round_offset
+  ok = (meta.owner_sorted < n_shards) & (pos >= 0) & (pos < cap)
   buckets = jnp.full((n_shards + 1, cap), fill_value, values.dtype)
   buckets = buckets.at[
       jnp.where(ok, meta.owner_sorted, n_shards),
-      jnp.where(ok, jnp.minimum(meta.pos_in_bucket, cap - 1), 0)].set(
+      jnp.where(ok, jnp.clip(pos, 0, cap - 1), 0)].set(
           jnp.where(ok, vals_sorted, fill_value))
   return buckets[:n_shards]
 

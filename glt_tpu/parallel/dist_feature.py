@@ -27,8 +27,9 @@ from ..utils import as_numpy
 
 
 def _more_rounds_global(more: bool) -> bool:
-  """Agree the drain-loop continuation across processes (every process
-  must issue the same number of collective rounds)."""
+  """Agree a drain-loop continuation across processes. The serving path
+  no longer needs this (lookup_local drains in-program with a pmax'd
+  round count); kept for host-side analysis/benchmarks."""
   if jax.process_count() == 1:
     return more
   from jax.experimental import multihost_utils
@@ -40,8 +41,10 @@ def overflow_lanes(owner_key: np.ndarray, n_shards: int, b: int,
                    cap: int) -> np.ndarray:
   """Host replay of the device bucketing: True where a valid request
   (owner_key < n_shards) ranks past its per-owner bucket capacity for
-  its B-lane device block. Must mirror the jnp stable-argsort bucketing
-  in lookup_local exactly."""
+  its B-lane device block. The SERVING path no longer uses this (the
+  drain runs in-program, see lookup_local); it remains for round-count
+  analysis (benchmarks/bench_bucket_drain.py predicts the grid with
+  it)."""
   over = np.zeros(owner_key.shape[0], bool)
   for lo in range(0, owner_key.shape[0], b):
     ok = owner_key[lo:lo + b]
@@ -75,12 +78,9 @@ def require_device_resident(store, ctx: str) -> None:
         'store (split_ratio=1.0), or the loader-driven path '
         '(DistLoader / NodeLoader collate, which resolves cold rows '
         'on host between device calls)')
-  if getattr(store, 'bucket_cap', 0):
-    raise NotImplementedError(
-        f'{ctx}: bucket_cap relies on lookup()\'s host-side overflow '
-        'drain, which cannot run inside the fused jitted step — '
-        'overflowed lanes would silently train as zeros; use '
-        'bucket_cap=0 here (capped lookups are for the loader path)')
+  # bucket_cap needs NO rejection here: lookup_local drains capped
+  # buckets in-program (round loop + pmax round count), so fused steps
+  # serve overflow lanes exactly — including combined with host-offload
 
 
 class ShardedFeature:
@@ -202,110 +202,120 @@ class ShardedFeature:
         phase. Fused train steps pass ``self.cold_array``'s shard here.
 
     Returns [B, D]; invalid slots are zero.
+
+    With ``bucket_cap`` set the overflow drain runs IN-PROGRAM: the
+    round count is the mesh-wide max bucket occupancy over the cap
+    (pmax — identical everywhere, so the collectives inside the
+    lax.while_loop stay aligned) and round k ships the requests ranked
+    [k*cap, (k+1)*cap) within each bucket. No host replay, no
+    cross-process agreement round — fused SPMD train steps can use
+    capped stores directly.
     """
+    from .collectives import (BucketMeta, all_to_all, bucket_payload,
+                              drain_rounds, unbucket)
     ax = axis_name or self.axis
     n_shards = self.mesh.shape[self.axis]
     b = ids.shape[0]
     owner = jnp.clip(ids // self.rows_per_shard, 0, n_shards - 1)
     owner = jnp.where(valid, owner, n_shards)  # pads sort last
     order = jnp.argsort(owner, stable=True)    # group requests by owner
-    ids_sorted = jnp.take(ids, order)
     owner_sorted = jnp.take(owner, order)
     counts = jnp.bincount(jnp.minimum(owner_sorted, n_shards),
                           length=n_shards + 1)[:n_shards]
     offsets = jnp.cumsum(counts) - counts
     pos_in_bucket = jnp.arange(b) - jnp.take(
         offsets, jnp.minimum(owner_sorted, n_shards - 1))
+    meta = BucketMeta(order, owner_sorted, pos_in_bucket)
     # fixed-capacity request buckets [n_shards, C] (C = B by default)
     cap = (self.bucket_cap if 0 < self.bucket_cap < b else b)
-    sink_row, sink_col = n_shards, 0
-    keep = (owner_sorted < n_shards) & (pos_in_bucket < cap)
-    brow = jnp.where(keep, owner_sorted, sink_row)
-    req = jnp.full((n_shards + 1, cap), -1, ids.dtype)
-    req = req.at[brow, jnp.where(keep, pos_in_bucket,
-                                 sink_col)].set(
-        jnp.where(keep, ids_sorted, -1))
-    req = req[:n_shards]
-    # exchange requests: row p of the result = what peer p asked us for
-    req_in = jax.lax.all_to_all(req, ax, split_axis=0, concat_axis=0,
-                                tiled=False)
-    req_in = req_in.reshape(n_shards, cap)
-    # serve from the local block (hot rows only when spilling; cold
-    # lanes return zero and the host phase in lookup() fills them)
-    my_index = jax.lax.axis_index(ax)
-    local_rows = req_in - my_index * self.rows_per_shard
-    ok = (local_rows >= 0) & (local_rows < self.hot_count) & \
-        (req_in >= 0)
-    safe_rows = jnp.clip(local_rows, 0, self.hot_count - 1)
-    # one DMA descriptor per served row instead of XLA's
-    # per-output-element gather (the UnifiedTensor GatherTensorKernel
-    # analogue, done the TPU way), when enabled
-    from ..ops.pallas_kernels import resolve_row_gather
-    gather = resolve_row_gather(self._row_gather)
-    if gather is not None:
-      rows_out = gather(local_shard, safe_rows.reshape(-1)).reshape(
-          safe_rows.shape + (self.feature_dim,))
-    else:
-      rows_out = jnp.take(local_shard, safe_rows, axis=0)
-    served = jnp.where(ok[..., None], rows_out, 0)
-    if cold_shard is not None and self._spill:
-      # serve the owner's SPILLED rows from pinned host memory without
-      # leaving the program: index arithmetic stays on device, the
-      # gather itself runs host-side (raw indexing — bounds logic would
-      # materialize device-space constants inside the host region)
-      from jax.experimental import compute_on
-      cold_count = self.rows_per_shard - self.hot_count
-      cold_ok = (local_rows >= self.hot_count) & \
-          (local_rows < self.rows_per_shard) & (req_in >= 0)
-      cold_rows_idx = jnp.clip(local_rows - self.hot_count, 0,
-                               cold_count - 1)
-      idx_h = jax.device_put(cold_rows_idx.reshape(-1),
-                             jax.memory.Space.Host)
-      with compute_on.compute_on('device_host'):
-        cold_out = cold_shard[idx_h]
-      cold_out = jax.device_put(
-          cold_out, jax.memory.Space.Device).reshape(
-              cold_rows_idx.shape + (self.feature_dim,))
-      served = jnp.where(cold_ok[..., None],
-                         cold_out.astype(served.dtype), served)
-    # send responses back; row p now holds our requests served by peer p
-    resp = jax.lax.all_to_all(served, ax, split_axis=0, concat_axis=0,
-                              tiled=False)
-    resp = resp.reshape(n_shards, cap, self.feature_dim)
-    # positional stitch back to request order (over-capacity lanes get
-    # zero; lookup() drains them in a follow-up round)
-    gathered = resp[jnp.minimum(owner_sorted, n_shards - 1),
-                    jnp.minimum(pos_in_bucket, cap - 1)]
-    gathered = jnp.where(keep[:, None], gathered, 0)
-    out = jnp.zeros_like(gathered)
-    out = out.at[order].set(gathered)
+
+    def round_out(base):
+      """One bucket-exchange-serve-unbucket pass over the requests
+      ranked [base, base+cap) per bucket; other lanes come back 0."""
+      req = bucket_payload(ids, meta, n_shards, fill_value=-1,
+                           capacity=cap, round_offset=base)
+      # exchange requests: row p of the result = what peer p asked us
+      req_in = all_to_all(req, ax)
+      # serve from the local block (hot rows only when spilling; cold
+      # lanes return zero and the host phase in lookup() fills them)
+      my_index = jax.lax.axis_index(ax)
+      local_rows = req_in - my_index * self.rows_per_shard
+      ok = (local_rows >= 0) & (local_rows < self.hot_count) & \
+          (req_in >= 0)
+      safe_rows = jnp.clip(local_rows, 0, self.hot_count - 1)
+      # one DMA descriptor per served row instead of XLA's
+      # per-output-element gather (the UnifiedTensor GatherTensorKernel
+      # analogue, done the TPU way), when enabled
+      from ..ops.pallas_kernels import resolve_row_gather
+      gather = resolve_row_gather(self._row_gather)
+      if gather is not None:
+        rows_out = gather(local_shard, safe_rows.reshape(-1)).reshape(
+            safe_rows.shape + (self.feature_dim,))
+      else:
+        rows_out = jnp.take(local_shard, safe_rows, axis=0)
+      served = jnp.where(ok[..., None], rows_out, 0)
+      if cold_shard is not None and self._spill:
+        # serve the owner's SPILLED rows from pinned host memory
+        # without leaving the program: index arithmetic stays on
+        # device, the gather itself runs host-side (raw indexing —
+        # bounds logic would materialize device-space constants inside
+        # the host region)
+        from jax.experimental import compute_on
+        cold_count = self.rows_per_shard - self.hot_count
+        cold_ok = (local_rows >= self.hot_count) & \
+            (local_rows < self.rows_per_shard) & (req_in >= 0)
+        cold_rows_idx = jnp.clip(local_rows - self.hot_count, 0,
+                                 cold_count - 1)
+        idx_h = jax.device_put(cold_rows_idx.reshape(-1),
+                               jax.memory.Space.Host)
+        with compute_on.compute_on('device_host'):
+          cold_out = cold_shard[idx_h]
+        cold_out = jax.device_put(
+            cold_out, jax.memory.Space.Device).reshape(
+                cold_rows_idx.shape + (self.feature_dim,))
+        served = jnp.where(cold_ok[..., None],
+                           cold_out.astype(served.dtype), served)
+      # responses back; row p now holds our requests served by peer p
+      resp = all_to_all(served, ax)
+      resp = resp.reshape(n_shards, cap, self.feature_dim)
+      # positional stitch back to request order
+      return unbucket(resp, meta, n_shards, round_offset=base)
+
+    if cap >= b:
+      return round_out(0)  # a single uncapped round serves everything
+    rounds = drain_rounds(meta, n_shards, cap, ax)
+
+    def body(state):
+      k, acc = state
+      return k + 1, acc + round_out(k * cap)
+
+    _, out = jax.lax.while_loop(
+        lambda s: s[0] < rounds, body,
+        (jnp.zeros((), jnp.int32),
+         jnp.zeros((b, self.feature_dim), local_shard.dtype)))
     return out
 
   def lookup(self, ids, valid=None) -> jax.Array:
     """Whole-mesh lookup from the host side: ids [n_shards * B] laid out
-    shard-major; returns globally-sharded [n_shards * B, D]."""
+    shard-major; returns globally-sharded [n_shards * B, D]. Capped
+    stores drain their overflow inside the compiled program (see
+    lookup_local) — one call regardless of skew."""
     if self._traced_cap is None:
       self._traced_cap = self.bucket_cap
     elif self.bucket_cap != self._traced_cap:
       raise RuntimeError(
           f'bucket_cap changed from {self._traced_cap} to '
           f'{self.bucket_cap} after the first lookup compiled it in; '
-          'the cached device routing would no longer match the host '
-          'drain replay. Set bucket_cap before the first lookup, or '
-          'build a new ShardedFeature.')
+          'the cached program would keep routing with the old cap. '
+          'Set bucket_cap before the first lookup, or build a new '
+          'ShardedFeature.')
     ids_np = as_numpy(ids).astype(np.int64)
     ids = jnp.asarray(ids_np)
     if valid is None:
       valid = jnp.ones(ids.shape, bool)
     n_shards = self.mesh.shape[self.axis]
     assert ids.shape[0] % n_shards == 0
-    b = ids.shape[0] // n_shards
-    if 0 < self.bucket_cap < b:
-      out = self._lookup_capped(ids, ids_np,
-                                as_numpy(valid).astype(bool), n_shards,
-                                b)
-    else:
-      out = self._call_lookup_fn(ids, valid)
+    out = self._call_lookup_fn(ids, valid)
     if not self._spill or self.cold_array is not None:
       # host-offloaded stores serve cold lanes inside the program
       return out
@@ -317,30 +327,6 @@ class ShardedFeature:
     if self.cold_array is not None:
       return self._lookup_fn(self.array, self.cold_array, ids, valid)
     return self._lookup_fn(self.array, ids, valid)
-
-  def _lookup_capped(self, ids, ids_np, valid_np, n_shards, b):
-    """Drain overflowed requests through the SAME compiled lookup:
-    round k re-issues only the lanes the capped buckets could not carry
-    in round k-1. Served lanes are disjoint across rounds and unserved
-    lanes return zero, so the merge is a running add. Worst-case rounds
-    = ceil(B / C) (the all-ask-one-shard hot spot), where the total
-    bytes moved equal the old [P, B] single round — skew pays, the
-    common case doesn't."""
-    owner = np.where(
-        valid_np,
-        np.clip(ids_np // self.rows_per_shard, 0, n_shards - 1),
-        n_shards)
-    pending = valid_np
-    out = None
-    while True:
-      out_r = self._call_lookup_fn(ids, jnp.asarray(pending))
-      out = out_r if out is None else out + out_r
-      over = overflow_lanes(
-          np.where(pending, owner, n_shards), n_shards, b,
-          self.bucket_cap)
-      if not _more_rounds_global(bool(over.any())):
-        return out
-      pending = over
 
   def _resolve_cold_sharded(self, out, ids_np, valid_np, n_shards):
     """Host phase: cold-ness is arithmetic under the range rule, so the

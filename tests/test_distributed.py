@@ -947,8 +947,6 @@ def test_dist_feature_bucket_cap_parity(mesh, dist_datasets):
   valid = rng.random(N_PARTS * 16) < 0.8
   base = DistFeature.from_dist_datasets(mesh, dist_datasets)
   want = np.asarray(base.lookup(ids, jnp.asarray(valid)))
-  # bucket_cap must go through the constructor/builder so the host
-  # routing books are retained for the drain replay
   capped = DistFeature.from_dist_datasets(mesh, dist_datasets,
                                           bucket_cap=4)  # B=16/device
   got = np.asarray(capped.lookup(ids, jnp.asarray(valid)))
@@ -960,21 +958,94 @@ def test_dist_feature_bucket_cap_parity(mesh, dist_datasets):
   np.testing.assert_allclose(got2, want)
 
 
-def test_dist_feature_bucket_cap_post_hoc_rejected(mesh, dist_datasets):
-  # setting bucket_cap after construction would silently zero overflow
-  # lanes; the drain must fail loudly instead
+def test_dist_hetero_train_step_capped_offloaded_spill(
+    tmp_path_factory, mesh):
+  """VERDICT r4 next #7: bucket_cap + host-offloaded spill COMBINED in
+  the fused hetero train step (IGBH shape: typed stores, rgnn, fused
+  sampling+gather+update). The in-program drain makes the combination
+  legal; losses must match a fully-resident uncapped run bit-for-bit
+  (zeros from undrained or unserved-cold lanes would shift them)."""
+  import optax
+  from glt_tpu.distributed import (
+      DistDataset, DistFeature, DistHeteroGraph, DistHeteroTrainStep,
+  )
+  from glt_tpu.models import RGNN
+  from glt_tpu.typing import reverse_edge_type
+  root = str(tmp_path_factory.mktemp('hetero_cap_spill'))
+  u2i = ('user', 'u2i', 'item')
+  i2i = ('item', 'i2i', 'item')
+  nu, ni = 16, 32
+  u = np.arange(nu)
+  u2i_ei = np.stack([np.repeat(u, 2),
+                     np.stack([2*u, 2*u+1], 1).reshape(-1) % ni])
+  i = np.arange(ni)
+  i2i_ei = np.stack([np.repeat(i, 2),
+                     np.stack([(i+1) % ni, (i+2) % ni], 1).reshape(-1)])
+  # value-encoded features: any lane served as zero changes the loss
+  feats = {'user': np.tile(np.arange(nu, dtype=np.float32)[:, None],
+                           (1, 8)) + 1.0,
+           'item': np.tile(np.arange(ni, dtype=np.float32)[:, None],
+                           (1, 8)) + 1.0}
+  RandomPartitioner(root, num_parts=N_PARTS,
+                    num_nodes={'user': nu, 'item': ni},
+                    edge_index={u2i: u2i_ei, i2i: i2i_ei},
+                    node_feat=feats).partition()
+  dg = DistHeteroGraph.from_dataset_partitions(mesh, root)
+  dss = [DistDataset().load(root, p) for p in range(N_PARTS)]
+  labels = {'user': (np.arange(nu) % 3).astype(np.int32)}
+  model = RGNN(edge_types=[reverse_edge_type(u2i), i2i],
+               hidden_features=8, out_features=3, num_layers=2,
+               conv='rsage')
+  tx = optax.sgd(1e-2)
+
+  def run(**store_kw):
+    dfeats = {t: DistFeature.from_dist_datasets(mesh, dss, ntype=t,
+                                                **store_kw)
+              for t in ('user', 'item')}
+    if store_kw:
+      assert all(st.cold_array is not None for st in dfeats.values())
+      assert all(st.bucket_cap == 4 for st in dfeats.values())
+    step = DistHeteroTrainStep(dg, dfeats, model, tx, labels,
+                               {u2i: [2, 2], i2i: [2, 2]},
+                               batch_size_per_device=2,
+                               seed_type='user', seed=0)
+    params = step.init_params(jax.random.key(0))
+    opt = tx.init(params)
+    rng = np.random.default_rng(0)
+    losses = []
+    for it in range(3):
+      seeds = rng.integers(0, nu, (N_PARTS, 2))
+      params, opt, loss = step(params, opt, seeds, np.full(N_PARTS, 2),
+                               jax.random.key(it))
+      losses.append(float(np.asarray(loss)[0]))
+    return losses
+
+  base = run()
+  combined = run(split_ratio=0.5, bucket_cap=4)
+  np.testing.assert_allclose(combined, base, rtol=1e-6)
+
+
+def test_dist_feature_bucket_cap_post_hoc_before_trace_ok(
+    mesh, dist_datasets):
+  # the in-program drain needs no retained host books, so a cap set
+  # any time BEFORE the first lookup (which bakes it into the trace)
+  # is honored exactly — even under worst-case hot-spot overflow
+  # (this replaced the old 'routing books' rejection, which guarded
+  # the host drain replay that no longer exists)
   df = DistFeature.from_dist_datasets(mesh, dist_datasets)
   df.bucket_cap = 4
   ids = np.zeros(N_PARTS * 16, np.int64)  # hot-spot: forces overflow
-  with pytest.raises(RuntimeError, match='routing books'):
-    df.lookup(ids)
+  out = np.asarray(df.lookup(ids))
+  base = DistFeature.from_dist_datasets(mesh, dist_datasets)
+  want = np.asarray(base.lookup(ids))
+  np.testing.assert_allclose(out, want)
 
 
 def test_dist_feature_bucket_cap_mutation_after_trace_rejected(
     mesh, dist_datasets):
   # the first lookup bakes the cap into the shard_map trace; mutating
-  # it afterwards would double-serve lanes (cached uncapped trace +
-  # host drain rounds) — must raise, not silently corrupt
+  # it afterwards would silently keep routing with the old cap — must
+  # raise, not silently diverge
   df = DistFeature.from_dist_datasets(mesh, dist_datasets, bucket_cap=4)
   ids = np.arange(N_PARTS * 16, dtype=np.int64) % N_NODES
   df.lookup(ids)
