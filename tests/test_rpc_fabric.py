@@ -144,6 +144,51 @@ def test_concurrent_event_loop():
   loop.shutdown()
 
 
+def test_concurrent_event_loop_error_semantics():
+  """ADVICE r4: nested submission fails loudly instead of deadlocking;
+  callback exceptions land in the future (not the executor logger) and
+  run only on success; run_task failures are consumed (wait_all must
+  not re-raise them)."""
+  from glt_tpu.distributed import ConcurrentEventLoop
+  loop = ConcurrentEventLoop(concurrency=1)
+
+  # nested add_task to the SAME loop -> loud error captured in future
+  def nested():
+    loop.add_task(lambda: None)
+  with pytest.raises(RuntimeError, match='nested add_task'):
+    loop.run_task(nested)
+
+  # ...but a SIBLING loop is a legal nested stage
+  sibling = ConcurrentEventLoop(concurrency=1)
+  assert loop.run_task(lambda: sibling.run_task(lambda: 7)) == 7
+  sibling.shutdown()
+
+  # callback errors surface through the future
+  def bad_cb(_):
+    raise ValueError('callback blew up')
+  fut = loop.add_task(lambda: 1, callback=bad_cb)
+  with pytest.raises(ValueError, match='callback blew up'):
+    fut.result()
+  loop._pending.clear()  # consumed above
+
+  # a failing task never invokes its callback
+  ran = []
+  fut = loop.add_task(
+      lambda: (_ for _ in ()).throw(RuntimeError('task failed')),
+      callback=ran.append)
+  with pytest.raises(RuntimeError, match='task failed'):
+    fut.result()
+  assert ran == []
+  loop._pending.clear()
+
+  # run_task consumes its own failure: wait_all stays clean
+  with pytest.raises(RuntimeError, match='once only'):
+    loop.run_task(lambda: (_ for _ in ()).throw(
+        RuntimeError('once only')))
+  loop.wait_all()  # must NOT re-raise
+  loop.shutdown()
+
+
 def _role_worker(rank: int, world: int, port: int, q) -> None:
   try:
     from glt_tpu.distributed import (

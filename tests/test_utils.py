@@ -89,3 +89,20 @@ def test_mesh_helpers():
   assert mesh.shape['data'] == 8
   assert replicated(mesh).spec == jax.sharding.PartitionSpec()
   assert row_sharded(mesh).spec == jax.sharding.PartitionSpec('data')
+
+
+def test_force_backend_guard():
+  """The central axon-footgun guard: idempotent when the requested
+  platform is already active; a too-late DIFFERENT platform raises."""
+  import pytest
+  from glt_tpu.utils.backend import force_backend
+  import jax
+  jax.devices()  # ensure the (cpu) backend is initialized
+  assert force_backend('cpu') == 'cpu'  # idempotent, no error
+  with pytest.raises(RuntimeError, match='after backend'):
+    force_backend('tpu')
+  # env-driven resolution: nothing set -> untouched
+  import os
+  for v in ('GLT_BENCH_PLATFORM', 'GLT_PLATFORM'):
+    assert v not in os.environ or os.environ.pop(v)
+  assert force_backend() is None
