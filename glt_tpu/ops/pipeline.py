@@ -39,15 +39,25 @@ def dedup_engine() -> str:
 
 
 def fused_hops() -> bool:
-  """GLT_FUSED_HOP=1 switches the sort engine's per-hop assign stage to
+  """GLT_FUSED_HOP switches the sort engine's per-hop assign stage to
   :func:`glt_tpu.ops.unique.sorted_hop_dedup_fused` (one narrow sort +
   one packed scatter per hop instead of two wide sorts; within-hop new
   labels come out in value order rather than slot order — see its
   docstring for why that is the only observable change). The seed hop
   always stays on the exact path so ``batch``/``seed_labels`` remain
   bit-identical to the table engine. Read at trace time, like
-  :func:`dedup_engine`."""
-  return os.environ.get('GLT_FUSED_HOP', '0').lower() in ('1', 'true')
+  :func:`dedup_engine`.
+
+  Default is ``auto``: ON when the sort engine is active on TPU —
+  decided by the round-5 hardware A/B (benchmarks/tpu_runs/
+  bench_sort_scan4.json: fused 29.87M vs plain 28.51M edges/s/chip,
+  and fused >= plain in every scan/PRNG variant measured that round);
+  OFF elsewhere (CPU measured it neutral-to-slower under contention).
+  GLT_FUSED_HOP=1|0 forces."""
+  mode = os.environ.get('GLT_FUSED_HOP', 'auto').lower()
+  if mode == 'auto':
+    return dedup_engine() == 'sort' and jax.default_backend() == 'tpu'
+  return mode in ('1', 'true')
 
 
 def checksum_outputs(out: Dict[str, jax.Array]) -> jax.Array:
