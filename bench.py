@@ -95,8 +95,21 @@ def run_worker():
   indptr = jnp.asarray(topo.indptr.astype(np.int32))
   indices = jnp.asarray(topo.indices)
 
-  one_hop = lambda ids, fanout, key, mask: sample_neighbors(
-      indptr, indices, ids, fanout, key, seed_mask=mask)
+  if os.environ.get('GLT_WINDOW_HOP', '0') in ('1', 'true'):
+    # window read path: per-row contiguous DMA + exact hub fix-up; the
+    # hub capacity comes from the graph's true hub count (host, once)
+    # so results stay bit-identical to the element path (ops/sample.py)
+    win_w = int(os.environ.get('GLT_WINDOW_W', '96'))
+    n_hub = int((np.diff(topo.indptr) > win_w).sum())
+    indices_win = jnp.concatenate(
+        [indices, jnp.full((win_w,), -1, indices.dtype)])
+    print(f'# window hop: W={win_w} n_hub={n_hub}', file=sys.stderr)
+    one_hop = lambda ids, fanout, key, mask: sample_neighbors(
+        indptr, indices, ids, fanout, key, seed_mask=mask,
+        window=(win_w, n_hub), indices_win=indices_win)
+  else:
+    one_hop = lambda ids, fanout, key, mask: sample_neighbors(
+        indptr, indices, ids, fanout, key, seed_mask=mask)
 
   import functools
   scan = max(int(os.environ.get('GLT_BENCH_SCAN', '4')), 1)

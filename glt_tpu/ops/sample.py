@@ -73,6 +73,27 @@ def _floyd_offsets(deg: jax.Array, u: jax.Array, fanout: int) -> jax.Array:
   return chosen
 
 
+def _gather_row_windows(src: jax.Array, start: jax.Array,
+                        width: int) -> jax.Array:
+  """[S, width] contiguous slice per row: win[s, j] = src[start[s] + j].
+
+  One gather descriptor per ROW instead of per element — on TPU this
+  lowers to per-row DMA of a contiguous run, the memory-access shape the
+  hardware is good at (vs the per-element random access of
+  ``jnp.take(src, slots)``). ``src`` must carry >= width slots of
+  padding past the last real element: CLIP mode clamps the *start* of an
+  out-of-range slice, which would silently shift tail windows on an
+  unpadded array (same contract as ops/pallas_kernels.py).
+  """
+  import jax.lax as lax
+  return lax.gather(
+      src, start[:, None].astype(jnp.int32),
+      lax.GatherDimensionNumbers(
+          offset_dims=(1,), collapsed_slice_dims=(),
+          start_index_map=(0,)),
+      slice_sizes=(width,), mode=lax.GatherScatterMode.CLIP)
+
+
 def sample_neighbors(
     indptr: jax.Array,
     indices: jax.Array,
@@ -82,6 +103,9 @@ def sample_neighbors(
     seed_mask: Optional[jax.Array] = None,
     edge_ids: Optional[jax.Array] = None,
     replace: bool = False,
+    window: Optional[tuple] = None,
+    indices_win: Optional[jax.Array] = None,
+    edge_ids_win: Optional[jax.Array] = None,
 ) -> NeighborOutput:
   """Uniformly sample up to ``fanout`` neighbors per seed from a CSR/CSC.
 
@@ -91,6 +115,18 @@ def sample_neighbors(
   Returns padded [S, fanout] neighbors + mask; when a seed's degree is
   <= fanout the sample is exhaustive and in adjacency order (which makes
   tiny-graph tests exact, the reference test strategy SURVEY.md §4).
+
+  ``window=(W, H)`` enables the TPU window read path: neighbor values
+  are read from a [S, W] contiguous per-row window (one DMA per row —
+  see :func:`_gather_row_windows`) instead of a [S, fanout] per-element
+  random gather, with the up-to-``H`` hub rows (degree > W) fixed up by
+  an exact [H, fanout] element gather. Offsets are drawn identically in
+  both paths, so results are BIT-IDENTICAL to the element path provided
+  ``H >= number of hub rows in the frontier`` — callers derive H from
+  the graph's true hub count (host-side, once) so the guarantee is
+  unconditional. Requires ``indices_win``: the same indices array with
+  >= W trailing padding slots (Graph.window_arrays / a one-time host
+  pad); ``edge_ids_win`` likewise when ``edge_ids`` is passed.
   """
   assert fanout > 0, 'fanout must be a static positive int'
   seeds = seeds.astype(indptr.dtype)
@@ -119,6 +155,34 @@ def sample_neighbors(
 
   slots = jnp.clip(start[:, None] + offsets.astype(start.dtype),
                    0, max(num_edges - 1, 0))
+  if window is not None:
+    w_width, n_hub = window
+    assert indices_win is not None, (
+        'window read path needs indices_win (W-padded indices); pass '
+        'Graph.window_arrays()["indices"] or pad host-side once')
+    win = _gather_row_windows(indices_win, start, w_width)   # [S, W]
+    woff = jnp.minimum(offsets, w_width - 1)
+    nbrs = jnp.take_along_axis(win, woff, axis=1)
+    if edge_ids is not None:
+      ewin = _gather_row_windows(edge_ids_win, start, w_width)
+      eids = jnp.take_along_axis(ewin, woff, axis=1)
+    else:
+      eids = slots
+    if n_hub > 0:  # exact fix-up: element-gather only the hub rows
+      hub_idx = jnp.nonzero(deg > w_width, size=n_hub,
+                            fill_value=0)[0]                 # [H]
+      hub_ok = jnp.take(deg, hub_idx) > w_width              # fill rows F
+      hub_slots = jnp.take(slots, hub_idx, axis=0)           # [H, K]
+      hub_vals = jnp.take(indices, hub_slots, mode='clip')
+      nbrs = nbrs.at[hub_idx].set(
+          jnp.where(hub_ok[:, None], hub_vals,
+                    jnp.take(nbrs, hub_idx, axis=0)))
+      if edge_ids is not None:
+        hub_eids = jnp.take(edge_ids, hub_slots, mode='clip')
+        eids = eids.at[hub_idx].set(
+            jnp.where(hub_ok[:, None], hub_eids,
+                      jnp.take(eids, hub_idx, axis=0)))
+    return NeighborOutput(nbrs=nbrs, mask=mask, eids=eids)
   nbrs = jnp.take(indices, slots, mode='clip')
   eids = jnp.take(edge_ids, slots, mode='clip') if edge_ids is not None \
       else slots
