@@ -39,14 +39,21 @@ ITERS = 6
 
 
 def timed_varying(fn, variants):
-  """Time fn over a list of DISTINCT argument tuples (axon memoizes
-  identical executions — see module docstring)."""
-  import jax
+  """Time fn over DISTINCT argument tuples, fenced by a host READBACK
+  of one element of the last output — on this tunnel neither identical
+  -args loops nor block_until_ready are trustworthy (see
+  microbench_gather_chained.py's calibration cell)."""
+  import numpy as np
+
+  def fence(o):
+    leaf = o[0] if isinstance(o, (tuple, list)) else o
+    return np.asarray(leaf).reshape(-1)[:1]
+
   out = fn(*variants[0])
-  jax.block_until_ready(out)
+  fence(out)
   t0 = time.time()
   outs = [fn(*v) for v in variants[1:]]
-  jax.block_until_ready(outs[-1])
+  fence(outs[-1])
   return (time.time() - t0) / (len(variants) - 1), outs[-1]
 
 

@@ -26,22 +26,39 @@ M = 768_000          # hop-2 slot count
 F = 153_600          # hop-2 frontier width
 
 
+def _fence(out):
+  """Hard completion fence: HOST READBACK of one element. On the axon
+  tunnel block_until_ready can return before device work completes
+  (microbench_gather_chained.py's calibration cell measured a 256 MB
+  copy at 23 TB/s under block_until_ready — 29x physical HBM — vs
+  31-800 GB/s under a value readback), so every timing boundary here
+  transfers a real value instead."""
+  import numpy as np
+  leaf = out[0] if isinstance(out, (tuple, list)) else out
+  return np.asarray(leaf).reshape(-1)[:1]
+
+
 def timed(fn, *args, iters=20, warmup=3, donate_idx=None):
-  import jax
+  """NB: without donate_idx every iteration reuses identical inputs;
+  results are only trustworthy when corroborated (the committed r5
+  cells for gathers/sorts match the in-program device trace). Cells
+  measured with identical args AND contradicting the trace
+  (window_gather_xla, uniform_rbg) are marked invalid in results_r5.md."""
+  import time as _t
   out = None
   state = list(args)
   for _ in range(warmup):
     out = fn(*state)
     if donate_idx is not None:
       state[donate_idx] = out[donate_idx] if isinstance(out, tuple) else out
-  jax.block_until_ready(out)
-  t0 = time.time()
+  _fence(out)
+  t0 = _t.time()
   for _ in range(iters):
     out = fn(*state)
     if donate_idx is not None:
       state[donate_idx] = out[donate_idx] if isinstance(out, tuple) else out
-  jax.block_until_ready(out)
-  return (time.time() - t0) / iters * 1e3
+  _fence(out)
+  return (_t.time() - t0) / iters * 1e3
 
 
 def main():

@@ -1,0 +1,188 @@
+"""Escalating Pallas-compile probe for the axon remote compiler.
+
+gather_windows (manual per-row DMA, PrefetchScalarGridSpec) dies with
+"HTTP 500: tpu_compile_helper subprocess exit code 1" on the tunnel —
+a server-side compiler crash with no visible diagnostics. This probe
+compiles+runs a ladder of kernels from trivial to the failing shape so
+the first failing rung names the construct:
+
+  1 vmem_id        : identity through VMEM blocks
+  2 smem_scalar    : scalar input in SMEM steering a @pl.when
+  3 dma_fixed      : manual HBM->VMEM async_copy of a static slice
+  4 dma_dynamic    : async_copy with pl.ds(dynamic scalar) source
+  5 prefetch_grid  : PrefetchScalarGridSpec with index_map using the
+                     prefetched scalars (the gather_rows pattern)
+  6 gather_windows : the real kernel at toy size
+  7 vmem_take2d    : in-kernel 2-D dynamic gather from a VMEM table
+
+Prints one status line per rung. Run on TPU with the chip otherwise
+idle.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+  import jax
+  from glt_tpu.utils.backend import force_backend
+  force_backend()
+  import jax.numpy as jnp
+  from jax.experimental import pallas as pl
+  from jax.experimental.pallas import tpu as pltpu
+
+  interpret = jax.default_backend() != 'tpu'
+  rng = np.random.default_rng(0)
+  status = {}
+
+  def rung(name, fn):
+    try:
+      out = fn()
+      _ = np.asarray(out).reshape(-1)[:1]
+      status[name] = 'ok'
+    except Exception as e:
+      status[name] = str(e)[:200]
+    print(json.dumps({name: status[name]}), flush=True)
+
+  # 1 vmem_id
+  x = jnp.asarray(rng.normal(size=(128, 128)).astype(np.float32))
+
+  def vmem_id():
+    def k(i, o):
+      o[:] = i[:]
+    return pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interpret)(x)
+
+  rung('1_vmem_id', vmem_id)
+
+  # 2 smem_scalar
+  def smem_scalar():
+    s = jnp.asarray([[3]], jnp.int32)
+
+    def k(s_ref, i_ref, o_ref):
+      o_ref[:] = i_ref[:] * s_ref[0, 0].astype(jnp.float32)
+
+    return pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec((1, 1), memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret)(s, x)
+
+  rung('2_smem_scalar', smem_scalar)
+
+  # 3 dma_fixed
+  big = jnp.asarray(rng.integers(0, 99, 4096, dtype=np.int32))
+
+  def dma_fixed():
+    def k(h_ref, o_ref):
+      def body(scr, sem):
+        dma = pltpu.make_async_copy(h_ref.at[pl.ds(256, 128)], scr, sem)
+        dma.start()
+        dma.wait()
+        o_ref[:] = scr[:]
+      pl.run_scoped(body, scr=pltpu.VMEM((128,), jnp.int32),
+                    sem=pltpu.SemaphoreType.DMA(()))
+
+    return pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((128,), jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret)(big)
+
+  rung('3_dma_fixed', dma_fixed)
+
+  # 4 dma_dynamic
+  def dma_dynamic():
+    st = jnp.asarray([[512]], jnp.int32)
+
+    def k(s_ref, h_ref, o_ref):
+      def body(scr, sem):
+        dma = pltpu.make_async_copy(
+            h_ref.at[pl.ds(s_ref[0, 0], 128)], scr, sem)
+        dma.start()
+        dma.wait()
+        o_ref[:] = scr[:]
+      pl.run_scoped(body, scr=pltpu.VMEM((128,), jnp.int32),
+                    sem=pltpu.SemaphoreType.DMA(()))
+
+    return pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((128,), jnp.int32),
+        in_specs=[pl.BlockSpec((1, 1), memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret)(st, big)
+
+  rung('4_dma_dynamic', dma_dynamic)
+
+  # 5 prefetch_grid — gather_rows pattern on (n,1,d) singleton trick
+  def prefetch_grid():
+    tab = jnp.asarray(rng.normal(size=(64, 1, 128)).astype(np.float32))
+    rows = jnp.asarray(rng.integers(0, 64, 16, dtype=np.int32))
+
+    def k(idx_ref, row_ref, o_ref):
+      o_ref[:] = row_ref[:]
+
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(16,),
+        in_specs=[pl.BlockSpec((1, 1, 128), lambda i, idx: (idx[i], 0, 0))],
+        out_specs=pl.BlockSpec((1, 1, 128), lambda i, idx: (i, 0, 0)),
+    )
+    out = pl.pallas_call(
+        k, grid_spec=gs,
+        out_shape=jax.ShapeDtypeStruct((16, 1, 128), jnp.float32),
+        interpret=interpret)(rows, tab)
+    ref = jnp.take(tab, rows, axis=0)
+    assert bool(jnp.allclose(out, ref)), 'prefetch_grid mismatch'
+    return out
+
+  rung('5_prefetch_grid', prefetch_grid)
+
+  # 6 gather_windows toy
+  def gw():
+    from glt_tpu.ops.pallas_kernels import gather_windows
+    arr = jnp.asarray(rng.integers(0, 99, 8192, dtype=np.int32))
+    starts = jnp.asarray(
+        np.sort(rng.integers(0, 8192 - 128, 64).astype(np.int32)))
+    out = gather_windows(arr, starts, 128, block=8, interpret=interpret)
+    ref = jnp.stack([jax.lax.dynamic_slice(arr, (int(s),), (128,))
+                     for s in np.asarray(starts)])
+    assert bool(jnp.array_equal(out, ref)), 'gather_windows mismatch'
+    return out
+
+  rung('6_gather_windows', gw)
+
+  # 7 vmem_take2d
+  def vt():
+    TN, TD = 64, 128
+    tab = jnp.asarray(rng.integers(0, 1 << 20, (TN, TD), dtype=np.int32))
+    idx = jnp.asarray(rng.integers(0, TN * TD, (8, 3840), dtype=np.int32))
+
+    def k(t_ref, i_ref, o_ref):
+      ii = i_ref[:]
+      o_ref[:] = t_ref[:][ii >> 7, ii & 127]
+
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct(idx.shape, jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret)(tab, idx)
+    ref = jnp.take(tab.reshape(-1), idx, mode='clip')
+    assert bool(jnp.array_equal(out, ref)), 'vmem_take mismatch'
+    return out
+
+  rung('7_vmem_take2d', vt)
+
+  print(json.dumps(status))
+
+
+if __name__ == '__main__':
+  main()
