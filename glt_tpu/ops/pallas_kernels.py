@@ -126,6 +126,17 @@ def gather_rows(table: jax.Array, rows: jax.Array,
   """table: [N, D]; rows: [B] int32 -> [B, D].
 
   Out-of-range rows are clamped (mode='clip' semantics of the XLA path).
+
+  Lowering note (r5 hardware session): the original (1, D) block spec
+  violated Mosaic's tiling rule (second-to-last block dim must divide 8
+  or equal the array dim) and never compiled; the singleton middle
+  dimension below satisfies it ("or equal": block (1, 1, D) vs array
+  (N, 1, D)), and probe_pallas_compile.py rung 5 confirms this form
+  compiles and runs on hardware. Measured there at 267 ns/row for
+  (1, 128) blocks — grid-step overhead bound, SLOWER than XLA's row
+  gather — so GLT_USE_PALLAS stays default-off; the kernel remains the
+  scaffold for a multi-input steered variant if per-step overhead ever
+  drops.
   """
   from jax.experimental import pallas as pl
   from jax.experimental.pallas import tpu as pltpu
@@ -133,6 +144,7 @@ def gather_rows(table: jax.Array, rows: jax.Array,
   n, d = table.shape
   b = rows.shape[0]
   rows = jnp.clip(rows.astype(jnp.int32), 0, n - 1)
+  table3 = table.reshape(n, 1, d)
 
   def kernel(idx_ref, row_ref, out_ref):
     out_ref[:] = row_ref[:]
@@ -141,13 +153,14 @@ def gather_rows(table: jax.Array, rows: jax.Array,
       num_scalar_prefetch=1,
       grid=(b,),
       in_specs=[
-          pl.BlockSpec((1, d), lambda i, idx: (idx[i], 0)),
+          pl.BlockSpec((1, 1, d), lambda i, idx: (idx[i], 0, 0)),
       ],
-      out_specs=pl.BlockSpec((1, d), lambda i, idx: (i, 0)),
+      out_specs=pl.BlockSpec((1, 1, d), lambda i, idx: (i, 0, 0)),
   )
-  return pl.pallas_call(
+  out = pl.pallas_call(
       kernel,
       grid_spec=grid_spec,
-      out_shape=jax.ShapeDtypeStruct((b, d), table.dtype),
+      out_shape=jax.ShapeDtypeStruct((b, 1, d), table.dtype),
       interpret=interpret,
-  )(rows, table)
+  )(rows, table3)
+  return out.reshape(b, d)
