@@ -128,8 +128,8 @@ def gather_rows(table: jax.Array, rows: jax.Array,
   Out-of-range rows are clamped (mode='clip' semantics of the XLA path).
 
   Lowering note (r5 hardware session): the original (1, D) block spec
-  violated Mosaic's tiling rule (second-to-last block dim must divide 8
-  or equal the array dim) and never compiled; the singleton middle
+  violated Mosaic's tiling rule (second-to-last block dim must be
+  divisible by 8 or equal the array dim) and never compiled; the singleton middle
   dimension below satisfies it ("or equal": block (1, 1, D) vs array
   (N, 1, D)), and probe_pallas_compile.py rung 5 confirms this form
   compiles and runs on hardware. Measured there at 267 ns/row for
