@@ -41,14 +41,16 @@ _CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(
 
 
 def _prefix_graph(src, dst, n_ctrl):
-  """Same-distribution control graph over the id prefix [0, n_ctrl):
-  keeps edges with both endpoints in range (the synthetic ids are
-  uniform/skew draws, so the prefix subgraph preserves degree shape)."""
-  import numpy as np
+  """Degree-preserving control graph over the id prefix [0, n_ctrl):
+  keeps every edge whose src is in range (out-degrees match the full
+  graph exactly, so per-hop sampling work is comparable) and folds dst
+  into range with a modulo (preserving the low-id skew shape; a
+  both-endpoints filter would thin average degree by the dst-keep
+  fraction and make the control's sampling easier than the real run)."""
   from glt_tpu.data import Dataset
-  keep = (src < n_ctrl) & (dst < n_ctrl)
+  keep = src < n_ctrl
   ds = Dataset(edge_dir='out')
-  ds.init_graph(edge_index=np.stack([src[keep], dst[keep]]),
+  ds.init_graph(edge_index=np.stack([src[keep], dst[keep] % n_ctrl]),
                 num_nodes=n_ctrl)
   return ds.get_graph()
 

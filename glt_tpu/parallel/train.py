@@ -56,6 +56,14 @@ class SPMDSageTrainStep:
     self.axis = axis
     graph.lazy_init()
     self.labels = jax.device_put(labels, NamedSharding(mesh, P()))
+    # one-time replication of the topology over the mesh: these ride
+    # the step as jit ARGUMENTS (constants would ship in the axon
+    # remote-compile payload — observed HTTP 413 at products scale),
+    # and pre-committing the replicated sharding here keeps the
+    # per-step call from re-broadcasting them each execution
+    self._indptr = jax.device_put(graph.indptr, NamedSharding(mesh, P()))
+    self._indices = jax.device_put(graph.indices,
+                                   NamedSharding(mesh, P()))
     n_dev = mesh.shape[axis]
     # per-device inducer tables, stacked on the mesh axis
     table, scratch = make_dedup_tables(graph.num_nodes)
@@ -88,8 +96,6 @@ class SPMDSageTrainStep:
     )
 
   def _build(self):
-    g = self.graph
-    indptr, indices = g.indptr, g.indices
     feature = self.feature
     model, tx, axis = self.model, self.tx, self.axis
     fanouts, bs = self.fanouts, self.bs
@@ -98,7 +104,8 @@ class SPMDSageTrainStep:
     offloaded = feature.cold_array is not None
 
     def device_step(params, opt_state, table, scratch, seeds, n_valid,
-                    key, feat_shard, labels, *cold_shard):
+                    key, feat_shard, labels, indptr, indices,
+                    *cold_shard):
       table = table[0]
       scratch = scratch[0]
       key = jax.random.fold_in(key[0], jax.lax.axis_index(axis))
@@ -137,20 +144,24 @@ class SPMDSageTrainStep:
     fn = jax.shard_map(
         device_step, mesh=self.mesh,
         in_specs=(P(), P(), P(self.axis), P(self.axis), P(self.axis),
-                  P(self.axis), P(self.axis), P(self.axis), P())
+                  P(self.axis), P(self.axis), P(self.axis), P(), P(),
+                  P())
         + ((P(self.axis),) if offloaded else ()),
         out_specs=(P(), P(), P(self.axis), P(self.axis), P(self.axis)),
         check_vma=False)
 
     @functools.partial(jax.jit, donate_argnums=(2, 3))
     def step(params, opt_state, tables, scratches, seeds, n_valid, keys,
-             feat_array, *cold):
-      # feat/cold ride as explicit args so their committed shardings —
-      # including the cold block's pinned_host memory kind — are
-      # preserved (a closed-over array would be re-laid-out as a
-      # default-memory constant)
+             feat_array, labels, indptr, indices, *cold):
+      # feat/cold/labels/topology ride as explicit args: (a) committed
+      # shardings — incl. the cold block's pinned_host memory kind —
+      # are preserved (a closed-over array would be re-laid-out as a
+      # default-memory constant), and (b) a closed-over array becomes a
+      # jit CONSTANT, which the axon remote-compile path ships in the
+      # compile request body — hundreds of MB of topology in the
+      # payload (observed HTTP 413 at products scale)
       return fn(params, opt_state, tables, scratches, seeds, n_valid,
-                keys, feat_array, self.labels, *cold)
+                keys, feat_array, labels, indptr, indices, *cold)
 
     return step
 
@@ -168,5 +179,6 @@ class SPMDSageTrainStep:
              if self.feature.cold_array is not None else ())
     params, opt_state, self.tables, self.scratches, loss = self._step_fn(
         params, opt_state, self.tables, self.scratches, seeds, n_valid,
-        keys, self.feature.array, *extra)
+        keys, self.feature.array, self.labels, self._indptr,
+        self._indices, *extra)
     return params, opt_state, loss
