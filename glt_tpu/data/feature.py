@@ -242,3 +242,53 @@ class Feature:
     if (~hot_mask).any():
       out[~hot_mask] = self.gather_cold_host(rows[~hot_mask])
     return out
+
+
+def gather_features(feat: Optional[Feature], node) -> Optional[jax.Array]:
+  """Batch gather over a Feature across BOTH residency classes — the
+  single collate-time gather path shared by the training loaders
+  (loader.node_loader) and the online serving engine (serving.engine).
+  Hot rows stay on device; cold rows ride the pinned-host block
+  (gather_mixed) when offloaded, else the host phase."""
+  if feat is None:
+    return None
+  rows = feat.map_ids(node)
+  if feat.fully_device_resident:
+    return feat.device_gather(rows)
+  feat.lazy_init()  # offload is decided at placement time
+  if feat.cold_array is not None:
+    # host-offloaded cold block: one jitted program serves both
+    # residency classes (compute_on host gather inside) — no host
+    # phase between batches at all (jnp.asarray is a no-op for rows
+    # already on device)
+    return feat.gather_mixed(jnp.asarray(rows))
+  # legacy mixed residency (host_offload=False): hot rows stay on
+  # device end-to-end; only the cold slice crosses host->device (the
+  # UVA-read analogue). The previous design pulled the hot gather D2H
+  # and re-uploaded the whole batch — hot rows crossed PCIe twice,
+  # defeating the split.
+  rows_np = as_numpy(rows).astype(np.int64)
+  if feat.hot_count == 0:
+    # no device block at all (split_ratio=0.0): the whole batch is
+    # cold; an empty jnp.take would raise, so serve host-side only
+    return jnp.asarray(feat.gather_cold_host(rows_np)
+                       .astype(feat.dtype))
+  rows_dev = jnp.asarray(rows_np)
+  hot = jnp.where(rows_dev < feat.hot_count, rows_dev, 0)
+  x = feat.device_gather(hot)                  # [B, D], cold lanes junk
+  cold_idx = np.nonzero(rows_np >= feat.hot_count)[0]
+  if cold_idx.size:
+    cold_vals = feat.gather_cold_host(rows_np[cold_idx]) \
+        .astype(feat.dtype)
+    # pad to the next power of two (duplicating the first cold lane)
+    # so the eager scatter compiles O(log B) shapes, not one per batch
+    cap = 1 << (int(cold_idx.size - 1)).bit_length()
+    pad = cap - cold_idx.size
+    if pad:
+      cold_idx = np.concatenate(
+          [cold_idx, np.full(pad, cold_idx[0], cold_idx.dtype)])
+      cold_vals = np.concatenate(
+          [cold_vals, np.broadcast_to(cold_vals[0], (pad,) +
+                                      cold_vals.shape[1:])])
+    x = x.at[jnp.asarray(cold_idx)].set(jax.device_put(cold_vals))
+  return x

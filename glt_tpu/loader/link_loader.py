@@ -15,6 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..data import Dataset
+from ..data.feature import gather_features
 from ..sampler import (
     EdgeSamplerInput, NegativeSampling, NeighborSampler,
 )
@@ -84,8 +85,7 @@ class LinkLoader(NodeLoader):
   def _collate_homo_link(self, out, n_valid) -> Batch:
     x = None
     if self.collect_features and self.data.node_features is not None:
-      x = self._gather_feature(self.data.get_node_feature(), out.node,
-                               out.node_count)
+      x = gather_features(self.data.get_node_feature(), out.node)
     batch = to_batch(out, x=x, batch_size=self.batch_size)
     meta = dict(batch.metadata or {})
     meta['n_valid'] = n_valid
@@ -99,8 +99,7 @@ class LinkLoader(NodeLoader):
         feat = (self.data.node_features.get(ntype)
                 if isinstance(self.data.node_features, dict) else None)
         if feat is not None:
-          x_dict[ntype] = self._gather_feature(
-              feat, node, out.node_count[ntype])
+          x_dict[ntype] = gather_features(feat, node)
     batch = to_hetero_batch(out, x_dict=x_dict, batch_size=self.batch_size)
     meta = dict(batch.metadata or {})
     meta['n_valid'] = n_valid

@@ -13,11 +13,11 @@ from __future__ import annotations
 
 from typing import Iterator, Optional, Union
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..data import Dataset, Feature
+from ..data.feature import gather_features
 from ..sampler import BaseSampler, NodeSamplerInput, SamplerOutput
 from ..utils import as_numpy
 from .transform import Batch, HeteroBatch, to_batch, to_hetero_batch
@@ -138,64 +138,17 @@ class NodeLoader:
     out = self.sampler.sample_from_nodes(seeds, n_valid=n_valid)
     return self._collate_homo(out, seeds, n_valid)
 
-  def _gather_feature(self, feat: Feature, node, node_count):
-    """Hot rows gathered on device; cold rows through the host (the
-    UVA-analogue path)."""
-    if feat is None:
-      return None
-    rows = feat.map_ids(node)
-    if feat.fully_device_resident:
-      return feat.device_gather(rows)
-    feat.lazy_init()  # offload is decided at placement time
-    if feat.cold_array is not None:
-      # host-offloaded cold block: one jitted program serves both
-      # residency classes (compute_on host gather inside) — no host
-      # phase between batches at all (jnp.asarray is a no-op for rows
-      # already on device)
-      return feat.gather_mixed(jnp.asarray(rows))
-    # legacy mixed residency (host_offload=False): hot rows stay on
-    # device end-to-end; only the cold slice crosses host->device (the
-    # UVA-read analogue). The previous design pulled the hot gather D2H
-    # and re-uploaded the whole batch — hot rows crossed PCIe twice,
-    # defeating the split.
-    rows_np = as_numpy(rows).astype(np.int64)
-    if feat.hot_count == 0:
-      # no device block at all (split_ratio=0.0): the whole batch is
-      # cold; an empty jnp.take would raise, so serve host-side only
-      return jnp.asarray(feat.gather_cold_host(rows_np)
-                         .astype(feat.dtype))
-    rows_dev = jnp.asarray(rows_np)
-    hot = jnp.where(rows_dev < feat.hot_count, rows_dev, 0)
-    x = feat.device_gather(hot)                  # [B, D], cold lanes junk
-    cold_idx = np.nonzero(rows_np >= feat.hot_count)[0]
-    if cold_idx.size:
-      cold_vals = feat.gather_cold_host(rows_np[cold_idx]) \
-          .astype(feat.dtype)
-      # pad to the next power of two (duplicating the first cold lane)
-      # so the eager scatter compiles O(log B) shapes, not one per batch
-      cap = 1 << (int(cold_idx.size - 1)).bit_length()
-      pad = cap - cold_idx.size
-      if pad:
-        cold_idx = np.concatenate(
-            [cold_idx, np.full(pad, cold_idx[0], cold_idx.dtype)])
-        cold_vals = np.concatenate(
-            [cold_vals, np.broadcast_to(cold_vals[0], (pad,) +
-                                        cold_vals.shape[1:])])
-      x = x.at[jnp.asarray(cold_idx)].set(jax.device_put(cold_vals))
-    return x
-
   def _collate_homo(self, out: SamplerOutput, seeds, n_valid) -> Batch:
     x = None
     if self.collect_features and self.data.node_features is not None:
-      x = self._gather_feature(self.data.get_node_feature(), out.node,
-                               out.node_count)
+      x = gather_features(self.data.get_node_feature(), out.node)
     y = None
     if self.data.node_labels is not None:
       y = jnp.asarray(self.data.get_node_label()[seeds])
     edge_attr = None
     if out.edge is not None and self.data.edge_features is not None:
       ef = self.data.get_edge_feature()
-      edge_attr = self._gather_feature(ef, jnp.maximum(out.edge, 0), None)
+      edge_attr = gather_features(ef, jnp.maximum(out.edge, 0))
     batch = to_batch(out, x=x, y=y, edge_attr=edge_attr,
                      batch_size=self.batch_size)
     meta = dict(batch.metadata or {})
@@ -209,8 +162,7 @@ class NodeLoader:
         feat = (self.data.node_features.get(ntype)
                 if isinstance(self.data.node_features, dict) else None)
         if feat is not None:
-          x_dict[ntype] = self._gather_feature(feat, node,
-                                               out.node_count[ntype])
+          x_dict[ntype] = gather_features(feat, node)
     y_dict = None
     if isinstance(self.data.node_labels, dict) \
         and self.input_type in self.data.node_labels:

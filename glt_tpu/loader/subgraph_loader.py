@@ -13,6 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..data import Dataset
+from ..data.feature import gather_features
 from ..sampler import NeighborSampler
 from .node_loader import NodeLoader
 from .transform import Batch
@@ -43,8 +44,8 @@ class SubGraphLoader(NodeLoader):
     node_valid = jnp.arange(sub.nodes.shape[0]) < sub.node_count
     x = None
     if self.collect_features and self.data.node_features is not None:
-      x = self._gather_feature(self.data.get_node_feature(),
-                               jnp.maximum(sub.nodes, 0), sub.node_count)
+      x = gather_features(self.data.get_node_feature(),
+                          jnp.maximum(sub.nodes, 0))
     y = None
     if self.data.node_labels is not None:
       y = jnp.asarray(self.data.get_node_label()[seeds])

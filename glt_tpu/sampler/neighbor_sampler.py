@@ -133,6 +133,14 @@ class NeighborSampler(BaseSampler):
 
   # -- helpers -----------------------------------------------------------
 
+  @property
+  def num_compiled_fns(self) -> int:
+    """Number of compiled multihop programs (one per seed-shape
+    signature). The serving engine's zero-recompile steady-state
+    guarantee is asserted against this: after bucket warmup it must
+    never grow."""
+    return sum(1 for k in self._fn_cache if k[0] in ('homo', 'hetero'))
+
   def _resolve_fanout(self, fanout: int, g: Graph) -> int:
     """Map the user-facing fanout to the internal encoding: positive =
     sample ``fanout``; ``-1`` resolves to ``-window`` where ``window`` is

@@ -1,0 +1,25 @@
+"""Online GNN inference: micro-batching, bucketed compilation, and an
+embedding cache over the training-side sampler/feature/model stack.
+
+The request path is::
+
+  ServingClient --rpc--> ServingServer --> MicroBatcher --> InferenceEngine
+                                                              |-- EmbeddingCache (LRU, versioned)
+                                                              |-- NeighborSampler (bucketed jit)
+                                                              |-- Feature.gather (hot/cold)
+                                                              `-- model forward (jit per bucket)
+
+See docs/serving.md for architecture, bucket tuning, and cache
+invalidation semantics.
+"""
+from .batcher import MicroBatcher, ServingOverloaded  # noqa: F401
+from .embedding_cache import EmbeddingCache  # noqa: F401
+from .engine import InferenceEngine  # noqa: F401
+from .metrics import LatencyHistogram, ServingMetrics  # noqa: F401
+from .server import ServingClient, ServingServer  # noqa: F401
+
+__all__ = [
+    'MicroBatcher', 'ServingOverloaded', 'EmbeddingCache',
+    'InferenceEngine', 'LatencyHistogram', 'ServingMetrics',
+    'ServingClient', 'ServingServer',
+]

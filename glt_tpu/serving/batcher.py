@@ -1,0 +1,226 @@
+"""Micro-batching request queue for online inference.
+
+XLA serves fixed shapes, so per-request execution wastes the device on
+tiny launches and — worse — recompiles on every new request size. The
+batcher merges concurrent requests into one micro-batch under two
+bounds (the classic serving trade-off):
+
+  * ``max_batch_size`` — flush as soon as the queued ids fill a batch
+    (throughput bound);
+  * ``max_wait_ms``    — flush when the OLDEST queued request has
+    waited this long, full or not (latency bound).
+
+Overload is handled at both ends: ``submit`` rejects immediately once
+the queue holds ``max_queue`` requests (backpressure — callers see
+:class:`ServingOverloaded` instead of unbounded queueing), and each
+request carries a deadline after which it is failed with TimeoutError
+rather than occupying a batch slot it can no longer use.
+
+The dispatcher is a single thread, which also serializes access to the
+engine (whose sampler threads donated buffers through its jitted
+programs and is therefore not reentrant).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Callable, List, Optional
+
+import numpy as np
+
+
+class ServingOverloaded(RuntimeError):
+  """Raised by submit() when the request queue is at capacity."""
+
+
+class _Request:
+  __slots__ = ('ids', 'future', 'deadline', 't_submit')
+
+  def __init__(self, ids, future, deadline, t_submit):
+    self.ids = ids
+    self.future = future
+    self.deadline = deadline
+    self.t_submit = t_submit
+
+
+class MicroBatcher:
+  """Deadline-driven micro-batch queue in front of a batch handler.
+
+  Args:
+    handler: ``fn(ids: np.ndarray[int64]) -> np.ndarray [len(ids), D]``
+      — rows aligned with the input ids (the engine's ``infer``).
+    max_batch_size: flush threshold in total queued ids; also the
+      capacity used for the batch-fill metric.
+    max_wait_ms: max time the oldest request waits before a partial
+      flush.
+    max_queue: request-count backpressure bound.
+    request_timeout_ms: default per-request deadline (None = no
+      deadline); ``submit`` can override per call.
+    metrics: optional ServingMetrics (batch fill + timeout/reject
+      counters).
+  """
+
+  def __init__(self, handler: Callable[[np.ndarray], np.ndarray],
+               max_batch_size: int = 64, max_wait_ms: float = 2.0,
+               max_queue: int = 1024,
+               request_timeout_ms: Optional[float] = 1000.0,
+               metrics=None):
+    assert max_batch_size > 0 and max_queue > 0
+    self.handler = handler
+    self.max_batch_size = int(max_batch_size)
+    self.max_wait = float(max_wait_ms) / 1e3
+    self.max_queue = int(max_queue)
+    self.request_timeout = (float(request_timeout_ms) / 1e3
+                            if request_timeout_ms is not None else None)
+    self.metrics = metrics
+    self._queue: 'deque[_Request]' = deque()
+    self._cond = threading.Condition()
+    self._running = True
+    self._force_flush = False
+    self._thread = threading.Thread(target=self._dispatch_loop,
+                                    daemon=True, name='glt-batcher')
+    self._thread.start()
+
+  # -- client side -------------------------------------------------------
+
+  def submit(self, ids, timeout_ms: Optional[float] = None) -> Future:
+    """Enqueue a request for embeddings of ``ids``; returns a Future
+    resolving to an aligned ``[len(ids), D]`` array. Raises
+    ServingOverloaded if the queue is full (backpressure), RuntimeError
+    after stop()."""
+    ids = np.asarray(ids, dtype=np.int64).reshape(-1)
+    timeout = (float(timeout_ms) / 1e3 if timeout_ms is not None
+               else self.request_timeout)
+    fut: Future = Future()
+    with self._cond:
+      if not self._running:
+        raise RuntimeError('batcher is stopped')
+      if len(self._queue) >= self.max_queue:
+        if self.metrics is not None:
+          self.metrics.record_rejected()
+        raise ServingOverloaded(
+            f'queue at capacity ({self.max_queue} requests)')
+      now = time.monotonic()
+      self._queue.append(_Request(
+          ids, fut, now + timeout if timeout is not None else None, now))
+      self._cond.notify()
+    return fut
+
+  def flush(self) -> None:
+    """Force an immediate flush of whatever is queued."""
+    with self._cond:
+      self._force_flush = True
+      self._cond.notify()
+
+  @property
+  def depth(self) -> int:
+    with self._cond:
+      return len(self._queue)
+
+  def stop(self) -> None:
+    """Stop the dispatcher; pending requests fail with RuntimeError."""
+    with self._cond:
+      self._running = False
+      pending = list(self._queue)
+      self._queue.clear()
+      self._cond.notify_all()
+    for r in pending:
+      r.future.set_exception(RuntimeError('batcher stopped'))
+    self._thread.join(timeout=5)
+
+  # -- dispatcher --------------------------------------------------------
+
+  def _expire_locked(self, now: float) -> None:
+    """Fail queued requests whose deadline has passed. A deadline
+    firing on an all-expired queue is the 'empty flush' case: the
+    handler is simply not called."""
+    live = deque()
+    for r in self._queue:
+      if r.deadline is not None and now >= r.deadline:
+        if self.metrics is not None:
+          self.metrics.record_timeout()
+        r.future.set_exception(TimeoutError(
+            f'request timed out after {now - r.t_submit:.3f}s in queue'))
+      else:
+        live.append(r)
+    self._queue = live
+
+  def _pop_batch_locked(self) -> List[_Request]:
+    """Take requests FIFO while they fit in max_batch_size total ids.
+    The head request always ships even if oversized by itself (the
+    engine chunks across buckets); later oversized requests wait for
+    the next flush rather than starving the current one."""
+    batch: List[_Request] = []
+    total = 0
+    while self._queue:
+      r = self._queue[0]
+      if batch and total + r.ids.size > self.max_batch_size:
+        break
+      batch.append(self._queue.popleft())
+      total += r.ids.size
+      if total >= self.max_batch_size:
+        break
+    return batch
+
+  def _next_wakeup_locked(self, now: float) -> float:
+    """Seconds until the next actionable instant: the oldest request's
+    flush deadline or the nearest per-request timeout."""
+    t = self._queue[0].t_submit + self.max_wait
+    for r in self._queue:
+      if r.deadline is not None:
+        t = min(t, r.deadline)
+    return max(t - now, 0.0)
+
+  def _dispatch_loop(self) -> None:
+    while True:
+      batch: List[_Request] = []
+      with self._cond:
+        while self._running:
+          now = time.monotonic()
+          self._expire_locked(now)
+          if not self._queue:
+            self._force_flush = False
+            self._cond.wait()
+            continue
+          total = sum(r.ids.size for r in self._queue)
+          oldest_wait = now - self._queue[0].t_submit
+          if (total >= self.max_batch_size
+              or oldest_wait >= self.max_wait or self._force_flush):
+            batch = self._pop_batch_locked()
+            if not self._queue:
+              self._force_flush = False
+            break
+          self._cond.wait(timeout=self._next_wakeup_locked(now))
+        if not self._running:
+          return
+      if batch:
+        self._dispatch(batch)
+
+  def _dispatch(self, batch: List[_Request]) -> None:
+    ids = np.concatenate([r.ids for r in batch])
+    if self.metrics is not None:
+      # an oversized head request ships whole: count its true size as
+      # the capacity so the fill ratio stays a [0, 1] utilization
+      self.metrics.record_batch(ids.size,
+                                max(ids.size, self.max_batch_size))
+    try:
+      out = self.handler(ids)
+      out = np.asarray(out)
+      if out.shape[0] != ids.size:
+        # a real error, not an assert: under python -O a misaligned
+        # handler would silently slice wrong rows to wrong callers
+        raise ValueError(
+            f'handler returned {out.shape[0]} rows for {ids.size} ids')
+    except BaseException as e:  # noqa: BLE001 — failures go to callers
+      for r in batch:
+        if not r.future.done():
+          r.future.set_exception(e)
+      return
+    lo = 0
+    for r in batch:
+      hi = lo + r.ids.size
+      if not r.future.done():
+        r.future.set_result(out[lo:hi])
+      lo = hi

@@ -1,0 +1,241 @@
+"""Bucketed online inference engine: k-hop sample -> feature gather ->
+model forward under pre-compiled padded shapes.
+
+XLA compiles one program per input shape, so naive request-sized
+execution recompiles the whole sample+forward pipeline on every new
+request size — seconds of latency per distinct size. The engine instead
+serves every request through a small set of **shape buckets**: a request
+for ``n`` embeddings runs in the smallest bucket ``B >= n``, padded, and
+``warmup()`` compiles every bucket up front so steady-state serving
+never traces again. The multi-hop sampler already compiles one program
+per seed shape (sampler/neighbor_sampler.py); buckets are exactly its
+cache keys, and the forward is jitted per bucket here with a trace
+counter that tests (and ``compile_stats``) can assert against.
+
+Results flow through the LRU :class:`~glt_tpu.serving.embedding_cache.
+EmbeddingCache` keyed ``(node_id, model_version)``: a request whose ids
+are all cached skips sampling and the forward entirely, and partial
+hits shrink the computed batch to the missing unique ids.
+
+The engine is intentionally NOT thread-safe per call (``infer`` takes an
+internal lock): the donated dedup tables inside the sampler's jitted
+programs make it non-reentrant. Put the :class:`MicroBatcher` in front
+of it — that is also where cross-request batching happens.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..data import Dataset
+from ..data.feature import gather_features
+from ..loader.transform import to_batch
+from ..sampler import NeighborSampler
+from ..utils import as_numpy
+from .embedding_cache import EmbeddingCache
+
+
+class InferenceEngine:
+  """Online embedding/logit server over a trained GNN.
+
+  Args:
+    data: Dataset (graph + node features; labels unused).
+    model: flax module whose ``apply(params, batch)`` returns a
+      ``[batch_size, D]`` array for the seed rows (GraphSAGE/RGNN
+      style). ``apply_fn`` overrides this contract if needed.
+    params: trained parameters (e.g. restored via
+      utils.checkpoint.restore_checkpoint).
+    num_neighbors: serving fanout per hop, e.g. ``[15, 10, 5]``.
+    buckets: padded seed-batch sizes to pre-compile, ascending. A
+      request larger than the biggest bucket is served in chunks of it.
+    cache: an EmbeddingCache, or None to build one of
+      ``cache_capacity`` entries (0 disables caching).
+    model_version: version tag for cache keys; ``set_params`` bumps it.
+    seed: sampler RNG seed (serving samples fresh neighborhoods per
+      request, matching the reference's inference-time sampling).
+  """
+
+  def __init__(self, data: Dataset, model, params,
+               num_neighbors: Sequence[int],
+               buckets: Sequence[int] = (8, 64, 256),
+               cache: Optional[EmbeddingCache] = None,
+               cache_capacity: int = 100_000,
+               model_version: int = 0,
+               seed: Optional[int] = 0,
+               apply_fn: Optional[Callable] = None,
+               with_edge: bool = False):
+    assert not isinstance(data.graph, dict), (
+        'serving engine is homogeneous-only for now (hetero serving '
+        'needs per-type bucket grids)')
+    self.data = data
+    self.model = model
+    self.params = params
+    self.buckets = tuple(sorted({int(b) for b in buckets}))
+    assert self.buckets and self.buckets[0] > 0
+    self.model_version = int(model_version)
+    self.cache = cache if cache is not None \
+        else EmbeddingCache(cache_capacity)
+    self.sampler = NeighborSampler(
+        data.graph, list(num_neighbors), edge_dir=data.edge_dir,
+        with_edge=with_edge, seed=seed)
+    self._apply_fn = apply_fn or (
+        lambda params, batch: self.model.apply(params, batch))
+    self._fwd = {}            # bucket -> jitted forward
+    self._trace_counts = {}   # bucket -> times the forward was traced
+    self.forward_calls = 0    # executed bucket runs (not traces)
+    self._out_dim: Optional[int] = None
+    self._warmed = False
+    self._lock = threading.Lock()
+
+  # -- compilation -------------------------------------------------------
+
+  def _make_forward(self, bucket: int):
+    def fwd(params, batch):
+      # trace-time side effect: executions never touch this counter, so
+      # steady-state assertions can demand it stays flat
+      self._trace_counts[bucket] = self._trace_counts.get(bucket, 0) + 1
+      return self._apply_fn(params, batch)
+    return jax.jit(fwd)
+
+  def _forward(self, bucket: int):
+    if bucket not in self._fwd:
+      self._fwd[bucket] = self._make_forward(bucket)
+    return self._fwd[bucket]
+
+  def warmup(self) -> dict:
+    """Compile every bucket's sample+gather+forward pipeline once with
+    dummy seeds. Serving before warmup works but pays compilation on
+    first use of each bucket."""
+    with self._lock:
+      for b in self.buckets:
+        self._run_bucket(np.zeros(b, np.int64), b, b)
+      self._warmed = True
+      # warmup never inserts into the cache (only infer does), so only
+      # the stats need resetting — a caller-supplied pre-populated
+      # cache must survive warmup intact
+      self.cache.reset_stats()
+      self.forward_calls = 0
+    return self.compile_stats()
+
+  def compile_stats(self) -> dict:
+    """Compilation/exec counters for the zero-recompile guarantee."""
+    return {
+        'forward_traces': dict(self._trace_counts),
+        'sampler_compiled_fns': self.sampler.num_compiled_fns,
+        'forward_calls': self.forward_calls,
+    }
+
+  @property
+  def output_dim(self) -> Optional[int]:
+    return self._out_dim
+
+  @property
+  def num_nodes(self) -> int:
+    return self.data.graph.num_nodes
+
+  def validate_ids(self, ids: np.ndarray) -> None:
+    """Reject out-of-range node ids: past the request boundary they
+    would be silently clamped by the gather paths — a wrong-but-valid-
+    looking embedding, cached under the bogus id forever."""
+    if ids.size and (ids.min() < 0 or ids.max() >= self.num_nodes):
+      bad = ids[(ids < 0) | (ids >= self.num_nodes)][:8]
+      raise ValueError(
+          f'node ids out of range [0, {self.num_nodes}): {bad.tolist()}')
+
+  # -- serving -----------------------------------------------------------
+
+  def bucket_for(self, n: int) -> int:
+    for b in self.buckets:
+      if n <= b:
+        return b
+    return self.buckets[-1]
+
+  def make_batch(self, seeds: np.ndarray, n_valid: int, bucket: int):
+    """Sample + gather a bucket-shaped Batch exactly as serving runs
+    it (public so param init / benchmarks build batches through the
+    same pipeline instead of re-rolling it)."""
+    out = self.sampler.sample_from_nodes(seeds, n_valid=n_valid)
+    x = gather_features(self.data.get_node_feature(), out.node)
+    # metadata carries per-call arrays (seed labels) — stripping it
+    # keeps the forward's pytree signature identical across calls
+    return to_batch(out, x=x, batch_size=bucket).replace(metadata=None)
+
+  def init_params(self, rng_key):
+    """Initialize (and install) model params against a bucket-shaped
+    batch — for serving fresh/benchmark weights without a training
+    loop."""
+    b = self.buckets[0]
+    batch = self.make_batch(np.zeros(b, np.int64), b, b)
+    self.params = self.model.init(rng_key, batch)
+    return self.params
+
+  def _run_bucket(self, seeds: np.ndarray, n_valid: int,
+                  bucket: int) -> np.ndarray:
+    """One padded pipeline pass; returns rows [:n_valid]."""
+    padded = seeds
+    if padded.shape[0] < bucket:
+      padded = np.concatenate(
+          [padded, np.full(bucket - padded.shape[0], padded[0] if
+                           padded.size else 0, padded.dtype)])
+    batch = self.make_batch(padded, n_valid, bucket)
+    emb = self._forward(bucket)(self.params, batch)
+    self.forward_calls += 1
+    rows = np.asarray(emb)[:n_valid]
+    if self._out_dim is None:
+      self._out_dim = int(rows.shape[1])
+    return rows
+
+  def infer(self, ids) -> np.ndarray:
+    """Embeddings/logits for ``ids`` (duplicates allowed), aligned with
+    the input order: cache hits served directly, the missing unique ids
+    computed through the smallest fitting bucket (chunked by the
+    largest bucket when needed) and inserted back into the cache."""
+    ids_np = as_numpy(ids).astype(np.int64).reshape(-1)
+    if ids_np.size == 0:
+      return np.zeros((0, self._out_dim or 0), np.float32)
+    with self._lock:
+      version = self.model_version
+      local = self.cache.lookup(ids_np, version)
+      missing = np.unique(ids_np[~np.isin(
+          ids_np, np.fromiter(local, np.int64, len(local)))]) \
+          if local else np.unique(ids_np)
+      lo = 0
+      while lo < missing.size:
+        chunk = missing[lo:lo + self.buckets[-1]]
+        lo += chunk.size
+        bucket = self.bucket_for(chunk.size)
+        rows = self._run_bucket(chunk, chunk.size, bucket)
+        self.cache.insert(chunk, rows, version)
+        for i, row in zip(chunk, rows):
+          local[int(i)] = row
+      return np.stack([local[int(i)] for i in ids_np])
+
+  # -- invalidation hooks ------------------------------------------------
+
+  def set_params(self, params, bump_version: bool = True) -> int:
+    """Hot-swap model parameters. With ``bump_version`` (default) the
+    cache version advances so stale embeddings stop hitting instantly;
+    the jitted programs are shape-stable and need no recompile."""
+    with self._lock:
+      self.params = params
+      if bump_version:
+        self.model_version += 1
+    return self.model_version
+
+  def invalidate(self, ids=None, version=None) -> int:
+    """Cache invalidation serialized against in-flight infer (the
+    engine lock): without it, invalidating ids an infer is currently
+    computing would drop nothing and the stale rows would be inserted
+    right after."""
+    with self._lock:
+      if ids is not None:
+        ids = as_numpy(ids).reshape(-1).tolist()
+      return self.cache.invalidate(ids, version)
+
+  def invalidate_nodes(self, ids) -> int:
+    """Feature/graph update hook: drop cached embeddings of ``ids``
+    across all versions."""
+    return self.invalidate(ids=ids)

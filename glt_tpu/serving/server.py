@@ -1,0 +1,138 @@
+"""RPC front-end for the inference engine.
+
+Reuses the :mod:`glt_tpu.distributed.rpc` fabric (the same
+length-prefixed socket protocol the server-client training mode runs
+on) so multi-process clients can query a TPU host without a new wire
+format. Each client connection is served on its own thread by
+RpcServer, so concurrent clients naturally interleave in the
+MicroBatcher and share micro-batches.
+
+Registered callees:
+  * ``infer(ids, timeout_ms=None)`` -> [len(ids), D] numpy
+  * ``stats()``                     -> metrics + cache + compile stats
+  * ``invalidate(ids=None, version=None)`` -> entries dropped
+  * ``ping()``                      -> server identity / readiness
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..distributed.rpc import RpcClient, RpcServer
+from ..utils.profile import Timer
+from .batcher import MicroBatcher
+from .engine import InferenceEngine
+from .metrics import ServingMetrics
+
+
+class ServingServer:
+  """Hosts an InferenceEngine behind a micro-batched RPC endpoint.
+
+  Args:
+    engine: the InferenceEngine (warmup is triggered here unless
+      ``warmup=False``).
+    host/port: bind address; port 0 picks an ephemeral port (read it
+      back from ``.address``).
+    max_batch_size: micro-batch id capacity; defaults to the engine's
+      largest bucket (a full micro-batch exactly fills one forward).
+    max_wait_ms / max_queue / request_timeout_ms: MicroBatcher knobs.
+  """
+
+  def __init__(self, engine: InferenceEngine, host: str = '127.0.0.1',
+               port: int = 0, max_batch_size: Optional[int] = None,
+               max_wait_ms: float = 2.0, max_queue: int = 1024,
+               request_timeout_ms: Optional[float] = 1000.0,
+               warmup: bool = True):
+    self.engine = engine
+    if warmup:
+      engine.warmup()
+    # metrics clock starts AFTER warmup: bucket compilation (tens of
+    # seconds on real models) must not dilute the reported QPS
+    self.metrics = ServingMetrics()
+    self.batcher = MicroBatcher(
+        engine.infer,
+        max_batch_size=max_batch_size or engine.buckets[-1],
+        max_wait_ms=max_wait_ms, max_queue=max_queue,
+        request_timeout_ms=request_timeout_ms, metrics=self.metrics)
+    self._request_timeout_ms = request_timeout_ms
+    # register BEFORE start(): a pre-registered server fails unknown
+    # names fast instead of stalling the connection (rpc.RpcServer)
+    self.rpc = RpcServer(host=host, port=port, auto_start=False)
+    self.rpc.register('infer', self.infer)
+    self.rpc.register('stats', self.stats)
+    self.rpc.register('invalidate', self.invalidate)
+    self.rpc.register('ping', self._ping)
+    self.rpc.start()
+
+  @property
+  def address(self):
+    return (self.rpc.host, self.rpc.port)
+
+  # -- callees (also the in-process API) ---------------------------------
+
+  def infer(self, ids, timeout_ms: Optional[float] = None) -> np.ndarray:
+    t = Timer().start()
+    # validate BEFORE batching: a bad id raised inside the dispatcher
+    # would fail every co-batched request, not just this caller's
+    self.engine.validate_ids(np.asarray(ids, dtype=np.int64).reshape(-1))
+    fut = self.batcher.submit(ids, timeout_ms=timeout_ms)
+    # the batcher enforces the queue deadline; the extra slack here only
+    # guards against a wedged dispatcher
+    wait = timeout_ms if timeout_ms is not None \
+        else self._request_timeout_ms
+    out = fut.result(timeout=None if wait is None else wait / 1e3 + 60)
+    self.metrics.record_request(t.stop(), np.asarray(ids).size)
+    return out
+
+  def stats(self) -> dict:
+    out = self.metrics.snapshot(cache=self.engine.cache)
+    out['engine'] = self.engine.compile_stats()
+    return out
+
+  def invalidate(self, ids=None, version=None) -> int:
+    # through the engine: serialized against in-flight infer
+    return self.engine.invalidate(ids=ids, version=version)
+
+  def _ping(self) -> dict:
+    return {'ok': True, 'buckets': list(self.engine.buckets),
+            'output_dim': self.engine.output_dim,
+            'model_version': self.engine.model_version}
+
+  def close(self) -> None:
+    self.batcher.stop()
+    self.rpc.stop()
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()
+
+
+class ServingClient:
+  """Thin client over the rpc fabric's RpcClient."""
+
+  def __init__(self, host: str, port: int, timeout: float = 180.0):
+    self._rpc = RpcClient(host, port, timeout=timeout)
+
+  def infer(self, ids, timeout_ms: Optional[float] = None) -> np.ndarray:
+    return np.asarray(self._rpc.request(
+        'infer', np.asarray(ids, dtype=np.int64),
+        timeout_ms=timeout_ms))
+
+  def infer_async(self, ids, timeout_ms: Optional[float] = None):
+    return self._rpc.async_request(
+        'infer', np.asarray(ids, dtype=np.int64), timeout_ms=timeout_ms)
+
+  def stats(self) -> dict:
+    return self._rpc.request('stats')
+
+  def invalidate(self, ids=None, version=None) -> int:
+    return self._rpc.request('invalidate', ids=ids, version=version)
+
+  def ping(self) -> dict:
+    return self._rpc.request('ping')
+
+  def close(self) -> None:
+    self._rpc.close()

@@ -60,7 +60,14 @@ class ThroughputMeter:
     return self.count / self.seconds if self.seconds > 0 else 0.0
 
   def report(self) -> str:
-    return f'{self.rate / 1e6:.2f}M {self.unit}/s'
+    # auto-scale the unit: a hard-coded /1e6 printed every sub-million
+    # rate (e.g. serving QPS) as '0.00M'
+    r = self.rate
+    if r >= 1e6:
+      return f'{r / 1e6:.2f}M {self.unit}/s'
+    if r >= 1e3:
+      return f'{r / 1e3:.2f}K {self.unit}/s'
+    return f'{r:.2f} {self.unit}/s'
 
 
 @contextlib.contextmanager

@@ -29,6 +29,19 @@ def test_train_sage_example():
   assert 'test acc:' in out
 
 
+@pytest.mark.slow
+def test_serve_sage_example():
+  """Train -> checkpoint -> restore -> serve over the rpc fabric.
+  (slow: two jax subprocess cold-starts; the in-process serving path is
+  covered by tests/test_serving.py in tier-1)"""
+  out = _run('serve_sage_products.py', '--nodes', '4000',
+             '--max-steps', '3', '--hidden', '32', '--queries', '8',
+             timeout=300)
+  assert 'checkpoint saved' in out
+  assert 'steady-state recompiles: 0' in out
+  assert 'cache_hit=' in out
+
+
 def test_unsup_example():
   out = _run('graph_sage_unsup.py', '--epochs', '1', timeout=300)
   assert 'loss=' in out

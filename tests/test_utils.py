@@ -83,6 +83,20 @@ def test_timer_and_meter():
   assert 'edges/s' in m.report()
 
 
+def test_meter_report_auto_scales_unit():
+  """Sub-million rates used to print '0.00M edges/s' (hard-coded /1e6);
+  the unit now auto-scales across raw / K / M."""
+  def at_rate(rate):
+    m = ThroughputMeter('req')
+    m.update(rate, 1.0)
+    return m.report()
+  assert at_rate(42) == '42.00 req/s'
+  assert at_rate(2_000) == '2.00K req/s'
+  assert at_rate(3_500_000) == '3.50M req/s'
+  assert at_rate(999) == '999.00 req/s'
+  assert ThroughputMeter('req').report() == '0.00 req/s'
+
+
 def test_mesh_helpers():
   from glt_tpu.parallel import make_mesh, replicated, row_sharded
   mesh = make_mesh(8)
