@@ -186,11 +186,40 @@ def run_worker():
   best = max((v, k) for k, v in engines.items()
              if isinstance(v, float))
   eps, chosen = best
+
+  # End-to-end train-step throughput, per-batch vs superstep engines
+  # side by side (PR: superstep training pipeline) — the growth bench
+  # trajectory then tracks training-loop wins, not just sampler
+  # throughput. Small fixed shapes independent of the headline knobs;
+  # budget-guarded and never fatal to the headline line.
+  train_ab = None
+  if os.environ.get('GLT_BENCH_TRAIN_AB', '1') != '0':
+    spent = time.time() - t_start
+    # conservative margin: the A/B takes ~30s on an idle box but the
+    # worker is HARD-KILLED at its budget (losing the already-measured
+    # headline), so only run it with several-x headroom
+    if not worker_budget or worker_budget - spent > 240:
+      try:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), 'benchmarks'))
+        from bench_train import measure_engines
+        d = measure_engines(supersteps=8)['detail']
+        train_ab = {
+            'per_batch': d['per_batch_steps_per_sec'],
+            'superstep': d['superstep_steps_per_sec'],
+            'speedup': d['speedup'],
+            'superstep_k': d['superstep_k'],
+            'batch': d['batch_size'],
+        }
+      except Exception as e:  # keep the measured headline regardless
+        train_ab = {'error': str(e)[:200]}
+
   _emit(round(eps, 1), round(eps / A100_ASSUMED_EDGES_PER_SEC, 4),
         backend=dev.platform, scan=scan, iters=ITERS, batch=BATCH,
         engine=chosen,
         engines={k: (round(v, 1) if isinstance(v, float) else v)
-                 for k, v in engines.items()})
+                 for k, v in engines.items()},
+        train_steps_per_sec=train_ab)
 
 
 def run_probe():

@@ -228,6 +228,36 @@ class Feature:
                           jnp.asarray(rows - self.hot_count)),
         dtype=self.dtype)
 
+  def stage_cold_rows(self, nodes: np.ndarray,
+                      counts: np.ndarray) -> np.ndarray:
+    """Host-gather the cold rows for pre-sampled node stacks — the
+    single-store counterpart of ``ShardedFeature.stage_cold_rows``
+    (which is what the SPMD streaming trainer in parallel/train.py
+    uses). This one is the staging primitive for loader-driven
+    single-store pipelines that pre-sample and then overlap the host
+    cold gather with device compute.
+
+    Args:
+      nodes: [..., B] POST-id2index row indices (apply ``map_ids``
+        first when an id map is configured).
+      counts: [...] valid-slot counts per node stack.
+
+    Returns [..., B, D] numpy: cold-row values on cold valid lanes,
+    zeros elsewhere (hot lanes resolve on device; merging is one
+    elementwise add/where).
+    """
+    self.lazy_init()
+    nodes = as_numpy(nodes).astype(np.int64)
+    counts = as_numpy(counts)
+    valid = np.arange(nodes.shape[-1]) < counts[..., None]
+    cold = valid & (nodes >= self.hot_count) & (nodes < self.num_rows)
+    np_dtype = np.dtype(jnp.dtype(self.dtype))
+    out = np.zeros(nodes.shape + (self.feature_dim,), np_dtype)
+    lanes = np.nonzero(cold)
+    if lanes[0].size:
+      out[lanes] = self.gather_cold_host(nodes[lanes]).astype(np_dtype)
+    return out
+
   def __getitem__(self, ids) -> np.ndarray:
     """Host-side convenience lookup returning numpy (reference cpu_get,
     feature.py:157-164)."""

@@ -228,7 +228,7 @@ class DistFeature:
     mesh-wide pmax of bucket occupancy over the cap) — no host replay
     of the routing, no retained books, and fused train steps can use
     capped stores (see parallel.collectives.drain_rounds)."""
-    from ..parallel.collectives import bucket_payload, drain_rounds
+    from ..parallel.collectives import bucket_payload, capped_drain
     ax = axis_name or self.axis
     n = self.num_partitions
     b = ids.shape[0]
@@ -290,27 +290,10 @@ class DistFeature:
 
     if not cap:
       return round_serve(0)
-    rounds = drain_rounds(meta, n, eff_cap, ax)
-    if two_outputs:
-      def body(state):
-        k, acc, flag = state
-        o, f = round_serve(k * eff_cap)
-        return k + 1, acc + o, flag | f
-      _, out, flag = jax.lax.while_loop(
-          lambda s: s[0] < rounds, body,
-          (jnp.zeros((), jnp.int32),
-           jnp.zeros((b, self.feature_dim), feat_shard.dtype),
-           jnp.zeros((b,), bool)))
-      return out, flag
-
-    def body(state):
-      k, acc = state
-      return k + 1, acc + round_serve(k * eff_cap)
-    _, out = jax.lax.while_loop(
-        lambda s: s[0] < rounds, body,
-        (jnp.zeros((), jnp.int32),
-         jnp.zeros((b, self.feature_dim), feat_shard.dtype)))
-    return out
+    zeros_feat = jnp.zeros((b, self.feature_dim), feat_shard.dtype)
+    zeros = ((zeros_feat, jnp.zeros((b,), bool)) if two_outputs
+             else zeros_feat)
+    return capped_drain(round_serve, meta, n, eff_cap, b, ax, zeros)
 
   def lookup(self, ids, valid=None) -> jax.Array:
     """Whole-mesh lookup: ids [P * B] shard-major.

@@ -156,7 +156,11 @@ class DistLinkNeighborLoader:
             if s.shape[0] < self.batch_size:
               s = np.concatenate(
                   [s, np.full(self.batch_size - s.shape[0], s[-1])])
-            srcs[p] = np.tile(s, max(amount, 1))[:self.num_neg]
+            # lane layout must match dst_neg_index's [bs, amount]
+            # reshape: amount consecutive lanes per source (repeat,
+            # NOT tile — tiling paired src i's negatives with src
+            # i*amount//bs and emitted real edges as "negatives")
+            srcs[p] = np.repeat(s, max(amount, 1))[:self.num_neg]
         neg_rows, neg_cols = self._strict_negatives(it, srcs)
       seeds, n_valid, n_pos = self._make_seeds(lo, orders, neg_rows,
                                                neg_cols)

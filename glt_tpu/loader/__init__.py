@@ -4,6 +4,10 @@ from .transform import (
 )
 from .node_loader import NodeLoader
 from .neighbor_loader import NeighborLoader
+from .device_epoch import (
+    DeviceEpochLoader, SeedSuperstep, pad_seed_batch, shard_n_valid,
+    stack_epoch_batches,
+)
 from .link_loader import LinkLoader, LinkNeighborLoader, \
     get_edge_label_index
 from .subgraph_loader import SubGraphLoader
@@ -12,6 +16,8 @@ __all__ = [
     'Batch', 'HeteroBatch', 'to_batch', 'to_hetero_batch', 'to_torch_data',
     'to_pyg_v1',
     'NodeLoader', 'NeighborLoader',
+    'DeviceEpochLoader', 'SeedSuperstep', 'pad_seed_batch',
+    'shard_n_valid', 'stack_epoch_batches',
     'LinkLoader', 'LinkNeighborLoader', 'get_edge_label_index',
     'SubGraphLoader',
 ]

@@ -1,0 +1,185 @@
+"""DeviceEpochLoader — on-device seed staging for superstep training.
+
+The per-batch loaders hand the trainer ONE padded seed batch per Python
+iteration, so every training step pays a host->device seed transfer and
+a jit dispatch. The superstep pipeline (ops/superstep.py) instead wants
+an epoch's worth of shuffled, padded seed batches staged on device ONCE
+as a ``[T, B]`` stack with per-batch ``n_valid``; the trainer then scans
+``K`` batches per dispatch. This module owns that staging, plus the
+single ragged-tail padding implementation the per-batch NodeLoader
+shares (``pad_seed_batch``).
+"""
+from __future__ import annotations
+
+from typing import Iterator, NamedTuple, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..utils import as_numpy
+
+
+def pad_seed_batch(seeds: np.ndarray,
+                   batch_size: int) -> Tuple[np.ndarray, int]:
+  """Pad a (possibly ragged) seed batch to the fixed batch size.
+
+  Fill slots repeat the last valid seed — a real node id, so downstream
+  sampling/gather shapes stay static and in-range; ``n_valid`` is what
+  masks them out of the loss. THE padding implementation: NodeLoader's
+  epoch iterator and the staged epoch stack below both call it.
+
+  Returns ``(padded [batch_size], n_valid)``.
+  """
+  n_valid = int(seeds.shape[0])
+  if n_valid == 0:
+    raise ValueError('cannot pad an empty seed batch')
+  if n_valid < batch_size:
+    seeds = np.concatenate(
+        [seeds, np.full(batch_size - n_valid, seeds[-1], seeds.dtype)])
+  return seeds, n_valid
+
+
+def stack_epoch_batches(seeds: np.ndarray, order: np.ndarray,
+                        batch_size: int,
+                        drop_last: bool) -> Tuple[np.ndarray, np.ndarray]:
+  """Slice one epoch's permuted seeds into padded fixed-size batches.
+
+  Returns ``(stack [T, batch_size], n_valid [T])`` — numpy, ready for a
+  single ``device_put``.
+  """
+  n = order.shape[0]
+  stack, n_valid = [], []
+  for lo in range(0, n, batch_size):
+    hi = min(lo + batch_size, n)
+    if hi - lo < batch_size and drop_last:
+      break
+    batch, nv = pad_seed_batch(seeds[order[lo:hi]], batch_size)
+    stack.append(batch)
+    n_valid.append(nv)
+  if not stack:  # fewer seeds than one batch under drop_last: empty
+    # epoch (the per-batch NodeLoader's semantics), not a stack error
+    return (np.empty((0, batch_size), seeds.dtype),
+            np.empty((0,), np.int32))
+  return (np.stack(stack),
+          np.asarray(n_valid, np.int32))
+
+
+def shard_n_valid(n_valid: np.ndarray, num_shards: int,
+                  shard_batch: int) -> np.ndarray:
+  """Split per-batch global valid counts into per-shard counts under the
+  shard-major seed layout (shard d owns slots [d*B, (d+1)*B)): shard d
+  of a batch with ``v`` valid seeds holds ``clip(v - d*B, 0, B)``.
+
+  n_valid: [T] -> returns [T, num_shards] int32.
+  """
+  d = np.arange(num_shards, dtype=np.int64) * shard_batch
+  return np.clip(n_valid.astype(np.int64)[:, None] - d[None, :],
+                 0, shard_batch).astype(np.int32)
+
+
+class SeedSuperstep(NamedTuple):
+  """One K-batch window of the staged epoch.
+
+  seeds: [K, B] device array (B = global batch = num_shards * per-shard
+    batch), a slice of the once-per-epoch staged stack — no fresh
+    host->device transfer.
+  n_valid: [K, num_shards] device array of per-shard valid counts.
+  length: K as a Python int (static; the tail window of an epoch whose
+    batch count is not divisible by the superstep length is shorter and
+    compiles its own program exactly once).
+  """
+  seeds: jax.Array
+  n_valid: jax.Array
+  length: int
+
+
+class DeviceEpochLoader:
+  """Stages an epoch of shuffled, padded seed batches on device once and
+  yields K-batch windows for superstep training.
+
+  Per epoch the host does ONE permutation + padding pass and ONE
+  ``device_put`` of the [T, B] stack (plus [T, S] valid counts); each
+  yielded window is a device-side slice. Compare NodeLoader, which
+  re-pads and re-uploads per batch.
+
+  Args:
+    seeds: seed node ids (any array-like).
+    batch_size: GLOBAL batch size (for SPMD: num_shards * per-device
+      batch, shard-major layout as SPMDSageTrainStep expects).
+    superstep_len: K, batches per dispatch.
+    num_shards: mesh width; n_valid comes back per-shard [K, num_shards].
+    shuffle/drop_last: epoch iteration controls (reference DataLoader
+      semantics, same as NodeLoader).
+    drop_last_superstep: also drop a trailing window shorter than K
+      (keeps every dispatch the compiled steady-state shape).
+    rng: numpy Generator for shuffling (seeded for reproducibility).
+    sharding: optional ``jax.sharding.Sharding`` for the staged stacks
+      (e.g. ``NamedSharding(mesh, P(None, 'data'))`` so each device
+      holds only its seed columns). Default: single-device placement.
+  """
+
+  def __init__(self, seeds, batch_size: int, superstep_len: int = 8,
+               num_shards: int = 1, shuffle: bool = False,
+               drop_last: bool = False,
+               drop_last_superstep: bool = False,
+               rng: Optional[np.random.Generator] = None,
+               sharding=None, n_valid_sharding=None):
+    self.seeds = as_numpy(seeds).astype(np.int64)
+    if self.seeds.shape[0] == 0:
+      raise ValueError('DeviceEpochLoader needs at least one seed')
+    self.batch_size = int(batch_size)
+    if self.batch_size % int(num_shards):
+      raise ValueError(
+          f'batch_size {batch_size} not divisible by num_shards '
+          f'{num_shards}')
+    self.superstep_len = max(1, int(superstep_len))
+    self.num_shards = int(num_shards)
+    self.shuffle = shuffle
+    self.drop_last = drop_last
+    self.drop_last_superstep = drop_last_superstep
+    self.rng = rng or np.random.default_rng(0)
+    self.sharding = sharding
+    self.n_valid_sharding = n_valid_sharding
+
+  @property
+  def batches_per_epoch(self) -> int:
+    n = self.seeds.shape[0]
+    if self.drop_last:
+      return n // self.batch_size
+    return (n + self.batch_size - 1) // self.batch_size
+
+  def __len__(self) -> int:
+    """Supersteps per epoch."""
+    t = self.batches_per_epoch
+    if self.drop_last_superstep:
+      return t // self.superstep_len
+    return (t + self.superstep_len - 1) // self.superstep_len
+
+  def stage_epoch(self) -> Tuple[jax.Array, jax.Array]:
+    """Shuffle, pad, and push one epoch to device: ``(seeds [T, B],
+    n_valid [T, S])``, both committed to the loader's shardings."""
+    order = (self.rng.permutation(self.seeds.shape[0])
+             if self.shuffle else np.arange(self.seeds.shape[0]))
+    stack, n_valid = stack_epoch_batches(
+        self.seeds, order, self.batch_size, self.drop_last)
+    per_shard = shard_n_valid(n_valid, self.num_shards,
+                              self.batch_size // self.num_shards)
+    seeds_dev = jax.device_put(stack.astype(np.int32), self.sharding)
+    nv_dev = jax.device_put(per_shard, self.n_valid_sharding)
+    return seeds_dev, nv_dev
+
+  def __iter__(self) -> Iterator[SeedSuperstep]:
+    seeds_dev, nv_dev = self.stage_epoch()
+    t = seeds_dev.shape[0]
+    k = self.superstep_len
+    for lo in range(0, t, k):
+      hi = min(lo + k, t)
+      if hi - lo < k and self.drop_last_superstep:
+        break
+      # device-side window slice of the staged stack; at most two
+      # distinct lengths per epoch (K and the tail), so the consumer
+      # compiles at most two programs
+      yield SeedSuperstep(
+          seeds=jax.lax.slice_in_dim(seeds_dev, lo, hi, axis=0),
+          n_valid=jax.lax.slice_in_dim(nv_dev, lo, hi, axis=0),
+          length=hi - lo)

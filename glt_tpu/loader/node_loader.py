@@ -20,6 +20,7 @@ from ..data import Dataset, Feature
 from ..data.feature import gather_features
 from ..sampler import BaseSampler, NodeSamplerInput, SamplerOutput
 from ..utils import as_numpy
+from .device_epoch import pad_seed_batch
 from .transform import Batch, HeteroBatch, to_batch, to_hetero_batch
 
 
@@ -119,13 +120,10 @@ class NodeLoader:
       hi = min(lo + self.batch_size, n)
       if hi - lo < self.batch_size and self.drop_last:
         break
-      idx = order[lo:hi]
-      seeds = self.seeds[idx]
-      n_valid = seeds.shape[0]
-      if n_valid < self.batch_size:  # pad ragged tail, keep shapes static
-        seeds = np.concatenate(
-            [seeds, np.full(self.batch_size - n_valid, seeds[-1],
-                            seeds.dtype)])
+      # ragged tail padded by the shared staged-pad helper (same fill
+      # rule as the superstep epoch stack, device_epoch.pad_seed_batch)
+      seeds, n_valid = pad_seed_batch(self.seeds[order[lo:hi]],
+                                      self.batch_size)
       yield self._make_batch(seeds, n_valid)
 
   # -- collate (reference node_loader.py:87-115 _collate_fn) -------------

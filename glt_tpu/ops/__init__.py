@@ -6,6 +6,7 @@ from .unique import ordered_unique, InducerState, init_node, induce_next
 from .negative import edge_in_csr, random_negative_sample, NegativeOutput
 from .subgraph import induced_subgraph, SubGraph
 from .stitch import stitch_rows
+from .superstep import superstep, scan_consume
 
 __all__ = [
     'NeighborOutput', 'sample_neighbors', 'sample_neighbors_weighted',
@@ -14,4 +15,5 @@ __all__ = [
     'edge_in_csr', 'random_negative_sample', 'NegativeOutput',
     'induced_subgraph', 'SubGraph',
     'stitch_rows',
+    'superstep', 'scan_consume',
 ]

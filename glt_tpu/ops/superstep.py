@@ -1,0 +1,78 @@
+"""Superstep: K full training steps inside one scanned dispatch.
+
+The per-batch training loop pays one Python iteration, one host->device
+seed transfer, and one jit dispatch per batch (loader/node_loader.py,
+parallel/train.py). :func:`glt_tpu.ops.pipeline.multihop_sample_many`
+already shows that scanning K *sampling* batches in one dispatch
+amortizes that overhead; this module generalizes the same lax.scan
+pattern to the WHOLE training step — sample -> feature gather ->
+forward/backward -> optimizer update — with the dedup tables, params and
+optimizer state threaded through the carry. Seed batches are staged on
+device up front as a [T, B] stack (loader.DeviceEpochLoader), so steady
+state is one dispatch per T batches and zero host round-trips on the hot
+path. PyTorch-Direct (arxiv 2101.07956) and GPU-initiated direct-storage
+sampling (arxiv 2306.16384) teach the same lesson on GPUs.
+
+The per-batch body must return its dedup tables RESET (the
+:func:`~glt_tpu.ops.pipeline.multihop_sample` contract), which makes
+scan iterations independent: a T-step superstep is bit-identical to T
+sequential calls of the same body with the same key stream.
+"""
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+
+# body of one training step:
+#   (params, opt_state, table, scratch, seeds, n_valid, key)
+#     -> (params, opt_state, table, scratch, aux)
+BatchStepFn = Callable[..., Tuple]
+
+
+def superstep(batch_step: BatchStepFn, unroll: int = 1):
+  """Lift a per-batch training body into a multi-batch lax.scan.
+
+  Args:
+    batch_step: one full training step (sample -> gather -> grad ->
+      update). Tables must come back reset so iterations stay
+      independent. ``aux`` is any pytree (typically the loss).
+    unroll: forwarded to ``lax.scan`` (TPU sampling A/Bs found modest
+      unrolling neutral; the knob exists for re-measurement).
+
+  Returns ``run(params, opt_state, table, scratch, seeds_stack [T, B],
+  n_valid_stack [T, ...], keys [T, ...]) -> (params, opt_state, table,
+  scratch, aux_stack)`` where ``aux_stack`` carries the per-batch aux
+  values stacked on a leading [T] axis. The leading axis of the three
+  stacked inputs must agree; each scan iteration consumes one slice.
+  """
+
+  def run(params, opt_state, table, scratch, seeds_stack, n_valid_stack,
+          keys):
+    def step(carry, x):
+      params, opt_state, table, scratch = carry
+      seeds, n_valid, key = x
+      params, opt_state, table, scratch, aux = batch_step(
+          params, opt_state, table, scratch, seeds, n_valid, key)
+      return (params, opt_state, table, scratch), aux
+
+    (params, opt_state, table, scratch), aux = jax.lax.scan(
+        step, (params, opt_state, table, scratch),
+        (seeds_stack, n_valid_stack, keys), unroll=unroll)
+    return params, opt_state, table, scratch, aux
+
+  return run
+
+
+def scan_consume(consume_step: Callable, unroll: int = 1):
+  """Scan a pre-staged consume body: ``consume_step(carry, x) ->
+  (carry, aux)`` over stacked inputs whose sampling already ran (the
+  cold-row streaming pipeline stages sampler outputs and cold feature
+  rows for superstep N+1 while the chip executes superstep N; the
+  consume scan then holds no dedup state — only params/opt ride the
+  carry)."""
+
+  def run(carry, xs):
+    return jax.lax.scan(consume_step, carry, xs, unroll=unroll)
+
+  return run

@@ -85,6 +85,48 @@ def drain_rounds(meta: BucketMeta, n_shards: int, cap: int,
   return jax.lax.pmax(local.astype(jnp.int32), axis_name)
 
 
+def capped_drain(round_out, meta: 'BucketMeta', n_shards: int, cap: int,
+                 b: int, axis_name: str, zeros):
+  """Accumulate ``round_out(base)`` over however many capped-exchange
+  rounds serve every request (see :func:`drain_rounds`).
+
+  ``round_out`` returns a pytree of per-request accumulators for the
+  requests ranked [base, base+cap) per bucket; rounds past the true
+  occupancy pack only fill lanes and therefore contribute exact
+  zeros/False. ``zeros`` is the matching all-zero pytree. Bool leaves
+  merge with ``|``, everything else with ``+``.
+
+  On modern jax the round count is a pmax'd traced scalar driving a
+  ``lax.while_loop`` (typical skew: one round). Legacy 0.4.x jax
+  MISCOMPILES collectives under a traced while_loop inside shard_map
+  (wrong values, not an error), so there the drain unrolls statically
+  to its worst case ceil(b/cap) — value-identical, always paying the
+  full exchange count. One implementation for every capped lookup path
+  (parallel + distributed feature stores).
+  """
+  from jax import tree_util  # jax.tree.map is younger than the 0.4.x
+  #                            targets the legacy branch exists for
+
+  def merge(a, o):
+    return a | o if a.dtype == jnp.bool_ else a + o
+
+  from ..utils import compat
+  if compat.LEGACY_JAX:
+    acc = zeros
+    for k in range(-(-b // cap)):
+      acc = tree_util.tree_map(merge, acc, round_out(k * cap))
+    return acc
+  rounds = drain_rounds(meta, n_shards, cap, axis_name)
+
+  def body(state):
+    k, acc = state
+    return k + 1, tree_util.tree_map(merge, acc, round_out(k * cap))
+
+  _, acc = jax.lax.while_loop(lambda s: s[0] < rounds, body,
+                              (jnp.zeros((), jnp.int32), zeros))
+  return acc
+
+
 def all_to_all(x: jax.Array, axis_name: str) -> jax.Array:
   """Exchange row p of x with peer p along ``axis_name``; x: [P, ...]."""
   n = x.shape[0]

@@ -212,7 +212,7 @@ class ShardedFeature:
     capped stores directly.
     """
     from .collectives import (BucketMeta, all_to_all, bucket_payload,
-                              drain_rounds, unbucket)
+                              capped_drain, unbucket)
     ax = axis_name or self.axis
     n_shards = self.mesh.shape[self.axis]
     b = ids.shape[0]
@@ -283,17 +283,62 @@ class ShardedFeature:
 
     if cap >= b:
       return round_out(0)  # a single uncapped round serves everything
-    rounds = drain_rounds(meta, n_shards, cap, ax)
+    return capped_drain(
+        round_out, meta, n_shards, cap, b, ax,
+        jnp.zeros((b, self.feature_dim), local_shard.dtype))
 
-    def body(state):
-      k, acc = state
-      return k + 1, acc + round_out(k * cap)
+  def _cold_values_host(self, nodes: np.ndarray, valid: np.ndarray):
+    """The host cold-row gather core shared by the lookup() host phase
+    and the streaming stager: range-rule arithmetic finds the cold
+    lanes (owner = id // rows_per_shard, cold = local >= hot_count),
+    values come from the per-partition ``_host_cold`` blocks. Returns
+    ([..., D] values with zeros on non-cold lanes, any_cold)."""
+    n_shards = self.mesh.shape[self.axis]
+    owner = np.clip(nodes // self.rows_per_shard, 0, n_shards - 1)
+    local = nodes - owner * self.rows_per_shard
+    cold = valid & (local >= self.hot_count) & (nodes >= 0) \
+        & (nodes < self.num_rows)
+    np_dtype = np.dtype(self.array.dtype)
+    out = np.zeros(nodes.shape + (self.feature_dim,), np_dtype)
+    lanes = np.nonzero(cold)
+    own = owner[lanes]
+    for p in np.unique(own):
+      m = tuple(ax[own == p] for ax in lanes)
+      out[m] = self._host_cold[int(p)][
+          local[m] - self.hot_count].astype(np_dtype)
+    return out, bool(lanes[0].size)
 
-    _, out = jax.lax.while_loop(
-        lambda s: s[0] < rounds, body,
-        (jnp.zeros((), jnp.int32),
-         jnp.zeros((b, self.feature_dim), local_shard.dtype)))
-    return out
+  def stage_cold_rows(self, nodes: np.ndarray,
+                      counts: np.ndarray) -> np.ndarray:
+    """Host-gather the SPILLED rows for pre-sampled node stacks — the
+    staging half of the superstep cold-row streaming pipeline
+    (parallel/train.py). Cold-ness is arithmetic under the range rule,
+    so no device round-trip is needed to find the lanes.
+
+    Args:
+      nodes: [..., n_shards * B] global node ids, shard-major blocks
+        (device d's B sampled slots at [..., d*B:(d+1)*B]).
+      counts: [..., n_shards] valid node counts per device block.
+
+    Returns [..., n_shards * B, D] numpy: cold-row values on cold valid
+    lanes, zeros elsewhere — exactly the lanes the in-program hot lookup
+    (``lookup_local`` without a cold shard) returns as zero, so the
+    consumer merges with one elementwise add.
+    """
+    if self._host_cold is None:
+      raise ValueError(
+          'stage_cold_rows serves host-spilled stores without a '
+          'pinned-host cold block; this store resolves cold rows '
+          'in-program (cold_array) or is fully device-resident')
+    nodes = as_numpy(nodes).astype(np.int64)
+    counts = as_numpy(counts)
+    n_shards = self.mesh.shape[self.axis]
+    nb = nodes.shape[-1]
+    b = nb // n_shards
+    lane = np.arange(nb) % b
+    dev = np.arange(nb) // b
+    valid = lane < counts[..., dev]
+    return self._cold_values_host(nodes, valid)[0]
 
   def lookup(self, ids, valid=None) -> jax.Array:
     """Whole-mesh lookup from the host side: ids [n_shards * B] laid out
@@ -332,18 +377,9 @@ class ShardedFeature:
     """Host phase: cold-ness is arithmetic under the range rule, so the
     requester finds its cold lanes without any device round-trip and
     merges them as one sharded add (cold lanes are zero in ``out``)."""
-    owner = np.clip(ids_np // self.rows_per_shard, 0, n_shards - 1)
-    local_row = ids_np - owner * self.rows_per_shard
-    cold = valid_np & (local_row >= self.hot_count) & \
-        (ids_np >= 0) & (ids_np < self.num_rows)
-    if not cold.any():
+    delta, any_cold = self._cold_values_host(ids_np, valid_np)
+    if not any_cold:
       return out
-    lanes = np.nonzero(cold)[0]
-    np_dtype = np.dtype(out.dtype)
-    delta = np.zeros((ids_np.shape[0], self.feature_dim), np_dtype)
-    for p in np.unique(owner[lanes]):
-      m = lanes[owner[lanes] == p]
-      delta[m] = self._host_cold[int(p)][
-          local_row[m] - self.hot_count].astype(np_dtype)
-    delta_arr = jax.device_put(delta, out.sharding)
+    delta_arr = jax.device_put(delta.astype(np.dtype(out.dtype)),
+                               out.sharding)
     return out + delta_arr

@@ -8,6 +8,25 @@ device (1) samples its own seed shard against the replicated topology,
 (2) resolves features from the row-sharded feature table via the
 all_to_all exchange in ShardedFeature, (3) computes grads, (4) psums —
 the NCCL allreduce riding ICI. Params/optimizer state stay replicated.
+
+Two execution modes share one batch body:
+
+  * per-batch (``__call__``): one dispatch per batch — one Python loop
+    iteration, one host->device seed transfer, one jit dispatch each.
+  * superstep (``superstep`` / ``run_epoch``): K batches per donated
+    dispatch via lax.scan (ops/superstep.py), consuming seed stacks the
+    DeviceEpochLoader staged on device once per epoch. Bit-identical to
+    K sequential per-batch calls (same RNG stream, same op sequence) —
+    the scan only amortizes the per-batch host round-trips.
+
+For host-spilled features WITHOUT the pinned-host cold block
+(``cold_array is None``) the fused body cannot resolve cold rows
+in-program; ``cold_streaming=True`` instead splits each superstep into a
+sampling scan and a consume scan: the host gathers the sampled cold rows
+(``ShardedFeature.stage_cold_rows``) and ``device_put``s them between the
+two, and ``run_epoch`` runs that stage phase for superstep N+1 on a
+prefetch thread while the chip executes superstep N (double buffering —
+``split_ratio < 1`` no longer serializes host gathers against compute).
 """
 from __future__ import annotations
 
@@ -16,6 +35,7 @@ from typing import Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -23,7 +43,29 @@ from ..data import Graph
 from ..ops.pipeline import edge_hop_offsets, multihop_sample, sample_budget
 from ..ops.sample import sample_neighbors
 from ..ops.pipeline import make_dedup_tables
+from ..ops.superstep import scan_consume, superstep as build_superstep
 from ..loader.transform import Batch
+
+
+def _sage_update(model, tx, axis, bs, params, opt_state, batch, n_valid):
+  """Forward/backward + DDP pmean + optimizer update for one batch —
+  the training tail shared by the per-batch, fused-superstep and
+  streaming-consume bodies (identical op sequence = loss parity)."""
+  def loss_fn(p):
+    logits = model.apply(p, batch)
+    mask = jnp.arange(bs) < n_valid
+    losses = optax.softmax_cross_entropy_with_integer_labels(
+        logits, batch.y)
+    return (jnp.where(mask, losses, 0).sum()
+            / jnp.maximum(mask.sum(), 1))
+
+  loss, grads = jax.value_and_grad(loss_fn)(params)
+  # DDP allreduce (mean over devices), riding ICI
+  grads = jax.lax.pmean(grads, axis)
+  loss = jax.lax.pmean(loss, axis)
+  updates, opt_state = tx.update(grads, opt_state, params)
+  params = optax.apply_updates(params, updates)
+  return params, opt_state, loss
 
 
 class SPMDSageTrainStep:
@@ -39,13 +81,28 @@ class SPMDSageTrainStep:
     labels: [N] label array (replicated).
     fanouts: per-hop fanouts.
     batch_size_per_device: seed count per device per step.
+    with_edge: also thread sampled edge ids through the pipeline into
+      ``Batch.edge`` (edge-feature consumers).
+    cold_streaming: opt-in — accept a host-spilled store WITHOUT the
+      pinned-host cold block by staging cold rows per superstep (see
+      module docstring). Only the superstep path serves such stores;
+      per-batch ``__call__`` raises. Without it, such stores are
+      rejected at construction exactly as before.
   """
 
   def __init__(self, mesh: Mesh, model, tx, graph: Graph, feature,
                labels, fanouts: Sequence[int],
-               batch_size_per_device: int, axis: str = 'data'):
+               batch_size_per_device: int, axis: str = 'data',
+               with_edge: bool = False, cold_streaming: bool = False):
     from .dist_feature import require_device_resident
-    require_device_resident(feature, 'SPMDSageTrainStep')
+    self._streaming = bool(cold_streaming)
+    if not self._streaming:
+      require_device_resident(feature, 'SPMDSageTrainStep')
+    elif not getattr(feature, '_spill', False) \
+        or getattr(feature, 'cold_array', None) is not None:
+      raise ValueError(
+          'cold_streaming=True needs a host-spilled store without a '
+          'pinned-host cold block (split_ratio < 1, host_offload=False)')
     self.mesh = mesh
     self.model = model
     self.tx = tx
@@ -54,6 +111,7 @@ class SPMDSageTrainStep:
     self.fanouts = list(fanouts)
     self.bs = batch_size_per_device
     self.axis = axis
+    self.with_edge = bool(with_edge)
     graph.lazy_init()
     self.labels = jax.device_put(labels, NamedSharding(mesh, P()))
     # one-time replication of the topology over the mesh: these ride
@@ -73,7 +131,17 @@ class SPMDSageTrainStep:
     self.scratches = jax.device_put(
         jnp.broadcast_to(scratch, (n_dev,) + scratch.shape),
         NamedSharding(mesh, P(axis)))
+    #: times each program was TRACED (trace-time side effect; executions
+    #: never bump these) — zero-steady-state-recompile assertions read
+    #: them. A fresh T (e.g. an epoch's ragged tail superstep) traces
+    #: once more by design.
+    self.step_traces = 0
+    self.superstep_traces = 0
     self._step_fn = self._build()
+    self._superstep_fn = self._build_superstep()
+    if self._streaming:
+      self._sample_fn = self._build_sample_superstep()
+      self._consume_fn = self._build_consume_superstep()
 
   def init_params(self, key) -> dict:
     batch = self._dummy_batch()
@@ -95,52 +163,55 @@ class SPMDSageTrainStep:
         edge_hop_offsets=tuple(edge_hop_offsets(self.bs, self.fanouts)),
     )
 
-  def _build(self):
-    feature = self.feature
-    model, tx, axis = self.model, self.tx, self.axis
+  # -- shared per-batch body ----------------------------------------------
+
+  def _make_batch_body(self, feat_shard, labels, indptr, indices,
+                       cold_shard):
+    """The body of ONE training step as seen from inside shard_map:
+    sample -> gather -> forward/backward -> pmean -> update. Shared
+    verbatim by the per-batch step and the superstep scan so the two
+    engines stay bit-identical."""
+    feature, model, tx, axis = self.feature, self.model, self.tx, self.axis
     fanouts, bs = self.fanouts, self.bs
     offs = tuple(edge_hop_offsets(bs, fanouts))
+    with_edge = self.with_edge
+    one_hop = lambda ids, fanout, k, mask: sample_neighbors(
+        indptr, indices, ids, fanout, k, seed_mask=mask)
 
-    offloaded = feature.cold_array is not None
-
-    def device_step(params, opt_state, table, scratch, seeds, n_valid,
-                    key, feat_shard, labels, indptr, indices,
-                    *cold_shard):
-      table = table[0]
-      scratch = scratch[0]
+    def body(params, opt_state, table, scratch, seeds, n_valid, key):
       key = jax.random.fold_in(key[0], jax.lax.axis_index(axis))
-      one_hop = lambda ids, fanout, k, mask: sample_neighbors(
-          indptr, indices, ids, fanout, k, seed_mask=mask)
       out, table, scratch = multihop_sample(
-          one_hop, seeds, n_valid[0], fanouts, key, table, scratch)
+          one_hop, seeds, n_valid[0], fanouts, key, table, scratch,
+          with_edge=with_edge)
       node_valid = jnp.arange(out['node'].shape[0]) < out['node_count']
       x = feature.lookup_local(
           feat_shard, jnp.maximum(out['node'], 0), node_valid,
-          axis_name=axis,
-          cold_shard=cold_shard[0] if cold_shard else None)
+          axis_name=axis, cold_shard=cold_shard)
       y = jnp.take(labels, jnp.maximum(out['batch'], 0)[:bs])
       batch = Batch(
           x=x, row=out['row'], col=out['col'], edge_mask=out['edge_mask'],
           node=out['node'], node_count=out['node_count'], y=y,
+          edge=out.get('edge'),
           batch_size=bs, edge_hop_offsets=offs)
+      params, opt_state, loss = _sage_update(
+          model, tx, axis, bs, params, opt_state, batch, n_valid[0])
+      return params, opt_state, table, scratch, loss
 
-      def loss_fn(p):
-        logits = model.apply(p, batch)
-        mask = jnp.arange(bs) < n_valid[0]
-        losses = optax.softmax_cross_entropy_with_integer_labels(
-            logits, y)
-        return (jnp.where(mask, losses, 0).sum()
-                / jnp.maximum(mask.sum(), 1))
+    return body
 
-      loss, grads = jax.value_and_grad(loss_fn)(params)
-      # DDP allreduce (mean over devices), riding ICI
-      grads = jax.lax.pmean(grads, axis)
-      loss = jax.lax.pmean(loss, axis)
-      updates, opt_state = tx.update(grads, opt_state, params)
-      params = optax.apply_updates(params, updates)
+  def _build(self):
+    def device_step(params, opt_state, table, scratch, seeds, n_valid,
+                    key, feat_shard, labels, indptr, indices,
+                    *cold_shard):
+      body = self._make_batch_body(
+          feat_shard, labels, indptr, indices,
+          cold_shard[0] if cold_shard else None)
+      params, opt_state, table, scratch, loss = body(
+          params, opt_state, table[0], scratch[0], seeds, n_valid, key)
       return (params, opt_state, table[None], scratch[None],
               loss[None])
 
+    offloaded = self.feature.cold_array is not None
     fn = jax.shard_map(
         device_step, mesh=self.mesh,
         in_specs=(P(), P(), P(self.axis), P(self.axis), P(self.axis),
@@ -160,14 +231,288 @@ class SPMDSageTrainStep:
       # jit CONSTANT, which the axon remote-compile path ships in the
       # compile request body — hundreds of MB of topology in the
       # payload (observed HTTP 413 at products scale)
+      self.step_traces += 1  # trace-time side effect only
       return fn(params, opt_state, tables, scratches, seeds, n_valid,
                 keys, feat_array, labels, indptr, indices, *cold)
 
     return step
 
+  # -- superstep: K batches per donated dispatch --------------------------
+
+  def _build_superstep(self):
+    """The fused superstep program: lax.scan of the per-batch body with
+    params/opt-state/dedup-tables in the carry. Unsupported for
+    streaming stores (cold rows are not in-program resolvable there);
+    ``superstep()`` routes those through sample+stage+consume."""
+    if self._streaming:
+      return None
+    axis = self.axis
+
+    def device_superstep(params, opt_state, tables, scratches,
+                         seeds_stack, n_valid_stack, keys, feat_shard,
+                         labels, indptr, indices, *cold_shard):
+      # per-device views: seeds_stack [T, bs], n_valid_stack [T, 1],
+      # keys [T, 1], tables [1, ...]
+      body = self._make_batch_body(
+          feat_shard, labels, indptr, indices,
+          cold_shard[0] if cold_shard else None)
+      run = build_superstep(body)
+      params, opt_state, table, scratch, losses = run(
+          params, opt_state, tables[0], scratches[0], seeds_stack,
+          n_valid_stack, keys)
+      return (params, opt_state, table[None], scratch[None],
+              losses[:, None])
+
+    offloaded = self.feature.cold_array is not None
+    stacked = P(None, self.axis)
+    fn = jax.shard_map(
+        device_superstep, mesh=self.mesh,
+        in_specs=(P(), P(), P(self.axis), P(self.axis), stacked,
+                  stacked, stacked, P(self.axis), P(), P(), P())
+        + ((P(self.axis),) if offloaded else ()),
+        out_specs=(P(), P(), P(self.axis), P(self.axis), stacked),
+        check_vma=False)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+    def step(params, opt_state, tables, scratches, seeds_stack,
+             n_valid_stack, keys, feat_array, labels, indptr, indices,
+             *cold):
+      self.superstep_traces += 1  # trace-time side effect only
+      return fn(params, opt_state, tables, scratches, seeds_stack,
+                n_valid_stack, keys, feat_array, labels, indptr,
+                indices, *cold)
+
+    return step
+
+  def _stacked_put(self, seeds_stack, n_valid_stack, keys):
+    """Commit superstep inputs to the [T, shard] layout. Inputs the
+    DeviceEpochLoader already staged (committed, correct sharding) pass
+    through without a copy."""
+    sh = NamedSharding(self.mesh, P(None, self.axis))
+    seeds = jax.device_put(jnp.asarray(seeds_stack, jnp.int32), sh)
+    n_valid = jax.device_put(jnp.asarray(n_valid_stack, jnp.int32), sh)
+    keys = jax.device_put(keys, sh)
+    return seeds, n_valid, keys
+
+  def superstep(self, params, opt_state, seeds_stack, n_valid_stack,
+                keys):
+    """Run T training steps in ONE donated dispatch.
+
+    seeds_stack: [T, n_dev * bs] shard-major per batch;
+    n_valid_stack: [T, n_dev]; keys: [T, n_dev] PRNG keys (batch t on
+    device d consumes keys[t, d], exactly as T sequential ``__call__``\\ s
+    consuming ``keys[t]`` would). Params/opt-state are DONATED — reuse
+    the returned ones. Returns (params, opt_state, loss [T, n_dev]).
+    """
+    seeds, n_valid, keys = self._stacked_put(seeds_stack, n_valid_stack,
+                                             keys)
+    if self._streaming:
+      staged = self._sample_and_stage(seeds, n_valid, keys)
+      return self._consume(params, opt_state, staged, n_valid)
+    extra = ((self.feature.cold_array,)
+             if self.feature.cold_array is not None else ())
+    (params, opt_state, self.tables, self.scratches,
+     loss) = self._superstep_fn(
+         params, opt_state, self.tables, self.scratches, seeds, n_valid,
+         keys, self.feature.array, self.labels, self._indptr,
+         self._indices, *extra)
+    return params, opt_state, loss
+
+  # -- cold-row streaming: sample scan + host stage + consume scan --------
+
+  def _build_sample_superstep(self):
+    """Sampling-only scan (the multihop_sample_many shape, but under
+    shard_map with the per-device key fold): produces the stacked
+    sampler outputs the consume scan and the host cold-stager read."""
+    axis, fanouts, bs = self.axis, self.fanouts, self.bs
+    with_edge = self.with_edge
+
+    def device_sample(tables, scratches, seeds_stack, n_valid_stack,
+                      keys, indptr, indices):
+      one_hop = lambda ids, fanout, k, mask: sample_neighbors(
+          indptr, indices, ids, fanout, k, seed_mask=mask)
+
+      def body(carry, x):
+        table, scratch = carry
+        seeds, n_valid, key = x
+        key = jax.random.fold_in(key[0], jax.lax.axis_index(axis))
+        out, table, scratch = multihop_sample(
+            one_hop, seeds, n_valid[0], fanouts, key, table, scratch,
+            with_edge=with_edge)
+        keep = dict(node=out['node'], node_count=out['node_count'][None],
+                    row=out['row'], col=out['col'],
+                    edge_mask=out['edge_mask'])
+        if with_edge:
+          keep['edge'] = out['edge']
+        return (table, scratch), keep
+
+      (table, scratch), outs = jax.lax.scan(
+          body, (tables[0], scratches[0]),
+          (seeds_stack, n_valid_stack, keys))
+      return table[None], scratch[None], outs
+
+    stacked = P(None, self.axis)
+    fn = jax.shard_map(
+        device_sample, mesh=self.mesh,
+        in_specs=(P(self.axis), P(self.axis), stacked, stacked, stacked,
+                  P(), P()),
+        out_specs=(P(self.axis), P(self.axis), stacked),
+        check_vma=False)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def sample(tables, scratches, seeds_stack, n_valid_stack, keys,
+               indptr, indices):
+      self.superstep_traces += 1  # trace-time side effect only
+      return fn(tables, scratches, seeds_stack, n_valid_stack, keys,
+                indptr, indices)
+
+    return sample
+
+  def _build_consume_superstep(self):
+    """Scan of gather+forward/backward+update over pre-sampled batches:
+    hot rows resolve through the all_to_all lookup (cold lanes zero),
+    the staged cold rows add in elementwise — the in-scan equivalent of
+    ShardedFeature._resolve_cold_sharded's host merge."""
+    feature, model, tx = self.feature, self.model, self.tx
+    axis, bs = self.axis, self.bs
+    offs = tuple(edge_hop_offsets(bs, self.fanouts))
+    budget = sample_budget(bs, self.fanouts)
+    with_edge = self.with_edge
+
+    def device_consume(params, opt_state, outs, cold_x, n_valid_stack,
+                       feat_shard, labels):
+      def body(carry, x):
+        params, opt_state = carry
+        out, cold_t, n_valid = x
+        node_count = out['node_count'][0]
+        node_valid = jnp.arange(budget) < node_count
+        xh = feature.lookup_local(
+            feat_shard, jnp.maximum(out['node'], 0), node_valid,
+            axis_name=axis)
+        x_feat = xh + cold_t.astype(xh.dtype)
+        y = jnp.take(labels, jnp.maximum(out['node'], 0)[:bs])
+        batch = Batch(
+            x=x_feat, row=out['row'], col=out['col'],
+            edge_mask=out['edge_mask'], node=out['node'],
+            node_count=node_count, y=y, edge=out.get('edge'),
+            batch_size=bs, edge_hop_offsets=offs)
+        params, opt_state, loss = _sage_update(
+            model, tx, axis, bs, params, opt_state, batch, n_valid[0])
+        return (params, opt_state), loss
+
+      run = scan_consume(body)
+      (params, opt_state), losses = run(
+          (params, opt_state), (outs, cold_x, n_valid_stack))
+      return params, opt_state, losses[:, None]
+
+    stacked = P(None, self.axis)
+    fn = jax.shard_map(
+        device_consume, mesh=self.mesh,
+        in_specs=(P(), P(), stacked, stacked, stacked, P(self.axis),
+                  P()),
+        out_specs=(P(), P(), stacked),
+        check_vma=False)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def consume(params, opt_state, outs, cold_x, n_valid_stack,
+                feat_array, labels):
+      self.superstep_traces += 1  # trace-time side effect only
+      return fn(params, opt_state, outs, cold_x, n_valid_stack,
+                feat_array, labels)
+
+    return consume
+
+  def _sample_and_stage(self, seeds, n_valid, keys):
+    """Dispatch the sampling scan, then host-gather + upload the cold
+    rows for every sampled node stack. run_epoch calls this from the
+    prefetch thread so the host gather for superstep N+1 overlaps the
+    chip executing superstep N."""
+    self.tables, self.scratches, outs = self._sample_fn(
+        self.tables, self.scratches, seeds, n_valid, keys,
+        self._indptr, self._indices)
+    cold = self.feature.stage_cold_rows(
+        np.asarray(outs['node']), np.asarray(outs['node_count']))
+    cold_x = jax.device_put(
+        cold, NamedSharding(self.mesh, P(None, self.axis)))
+    return outs, cold_x
+
+  def _consume(self, params, opt_state, staged, n_valid):
+    outs, cold_x = staged
+    params, opt_state, loss = self._consume_fn(
+        params, opt_state, outs, cold_x, n_valid, self.feature.array,
+        self.labels)
+    return params, opt_state, loss
+
+  # -- epoch drivers ------------------------------------------------------
+
+  def make_epoch_loader(self, seeds, superstep_len: int = 8,
+                        shuffle: bool = True, drop_last: bool = False,
+                        drop_last_superstep: bool = False,
+                        rng=None):
+    """A DeviceEpochLoader pre-committed to this trainer's mesh layout
+    (seed stacks [T, n_dev*bs] sharded on the batch axis)."""
+    from ..loader.device_epoch import DeviceEpochLoader
+    n_dev = self.mesh.shape[self.axis]
+    sh = NamedSharding(self.mesh, P(None, self.axis))
+    return DeviceEpochLoader(
+        seeds, batch_size=n_dev * self.bs, superstep_len=superstep_len,
+        num_shards=n_dev, shuffle=shuffle, drop_last=drop_last,
+        drop_last_superstep=drop_last_superstep, rng=rng, sharding=sh,
+        n_valid_sharding=sh)
+
+  def run_epoch(self, params, opt_state, loader, key,
+                stream_depth: int = 1):
+    """Drive one epoch of supersteps from a DeviceEpochLoader.
+
+    Non-streaming stores run the fused superstep per window. Streaming
+    stores double-buffer: the sample+stage phase (device sampling scan,
+    host cold-row gather, device_put) for window N+1 runs on a prefetch
+    thread while the consume scan for window N executes — the host
+    gather no longer serializes against compute. Returns
+    (params, opt_state, losses [T_total, n_dev]).
+    """
+    n_dev = self.mesh.shape[self.axis]
+
+    def keyed():
+      k = key
+      for ss in loader:
+        k, sub = jax.random.split(k)
+        yield ss, jax.random.split(sub, (ss.length, n_dev))
+
+    losses = []
+    if self._streaming:
+      from ..utils.prefetch import prefetch
+
+      def staged():
+        for ss, keys in keyed():
+          seeds, n_valid, keys = self._stacked_put(ss.seeds, ss.n_valid,
+                                                   keys)
+          yield self._sample_and_stage(seeds, n_valid, keys), n_valid
+
+      for stage, n_valid in prefetch(staged(), depth=max(1,
+                                                         stream_depth)):
+        params, opt_state, loss = self._consume(params, opt_state,
+                                                stage, n_valid)
+        losses.append(loss)
+    else:
+      for ss, keys in keyed():
+        params, opt_state, loss = self.superstep(
+            params, opt_state, ss.seeds, ss.n_valid, keys)
+        losses.append(loss)
+    if not losses:  # empty epoch (e.g. drop_last_superstep ate it all)
+      return params, opt_state, jnp.zeros((0, n_dev))
+    return params, opt_state, jnp.concatenate(losses, axis=0)
+
+  # -- per-batch path -----------------------------------------------------
+
   def __call__(self, params, opt_state, seeds, n_valid_per_device, keys):
     """seeds: [n_dev * bs] shard-major; n_valid_per_device: [n_dev];
     keys: [n_dev] PRNG keys. Returns (params, opt_state, loss[n_dev])."""
+    if self._streaming:
+      raise NotImplementedError(
+          'cold_streaming stores run through superstep()/run_epoch(); '
+          'the per-batch step cannot resolve host-spilled rows '
+          'in-program')
     n_dev = self.mesh.shape[self.axis]
     seeds = jax.device_put(
         jnp.asarray(seeds, jnp.int32),

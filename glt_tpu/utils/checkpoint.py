@@ -47,6 +47,12 @@ def restore_checkpoint(ckpt_dir: str, step: Optional[int] = None,
   if template is not None:
     out = mgr.restore(step, args=ocp.args.StandardRestore(template))
   else:
-    out = mgr.restore(step)
+    try:
+      out = mgr.restore(step)
+    except KeyError:
+      # newer orbax refuses a bare restore of a StandardSave item
+      # without args; an explicit template-less StandardRestore
+      # reconstructs the tree as saved
+      out = mgr.restore(step, args=ocp.args.StandardRestore())
   mgr.close()
   return step, out

@@ -1,0 +1,68 @@
+"""JAX version compatibility shims.
+
+The codebase targets the current jax API (``jax.shard_map`` with
+``check_vma``, ``jax.memory.Space``); some deployment images pin an
+older jax (0.4.x) where shard_map still lives at
+``jax.experimental.shard_map.shard_map`` with the ``check_rep`` kwarg
+and the memory-space enum does not exist yet. Rather than fork every
+call site, :func:`install` backfills the modern names onto the ``jax``
+module once, at ``glt_tpu`` import time. On a current jax it is a
+no-op.
+"""
+from __future__ import annotations
+
+import functools
+import types
+
+import jax
+
+
+def _shard_map_backport():
+  from jax.experimental.shard_map import shard_map as legacy
+
+  @functools.wraps(legacy)
+  def shard_map(f, *, mesh=None, in_specs=None, out_specs=None,
+                check_vma=True, **kwargs):
+    # modern kwarg name -> legacy one; semantics are identical (whether
+    # to verify per-output replication/varying-manual-axes claims)
+    kwargs.setdefault('check_rep', check_vma)
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  **kwargs)
+
+  return shard_map
+
+
+#: True when install() had to backfill jax.shard_map — i.e. we are on a
+#: legacy (0.4.x) jax. Some code paths work around old-jax miscompiles
+#: keyed off this (e.g. collectives under a traced lax.while_loop inside
+#: shard_map produce wrong values there; the capped-bucket drain then
+#: unrolls statically instead).
+LEGACY_JAX = False
+
+
+def install() -> None:
+  """Idempotently backfill modern jax API names used by glt_tpu."""
+  global LEGACY_JAX
+  if not hasattr(jax, 'shard_map'):
+    LEGACY_JAX = True
+    jax.shard_map = _shard_map_backport()
+  if not hasattr(jax.lax, 'axis_size'):
+    from jax._src import core as _core
+
+    def axis_size(axis_name):
+      # 0.4.x: the axis env frame for a name IS its (static int) size
+      return _core.axis_frame(axis_name)
+
+    jax.lax.axis_size = axis_size
+  if not hasattr(jax, 'memory'):
+    # jax.memory.Space.{Host,Device} appeared after 0.4.x; the transfer
+    # targets map onto the older TransferToMemoryKind markers
+    try:
+      from jax._src.sharding_impls import TransferToMemoryKind
+      space = types.SimpleNamespace(
+          Host=TransferToMemoryKind('pinned_host'),
+          Device=TransferToMemoryKind('device'))
+      jax.memory = types.SimpleNamespace(Space=space)
+    except ImportError:
+      pass  # neither the modern nor the legacy spelling exists: leave
+      # the offload paths to their own graceful fallbacks

@@ -22,6 +22,28 @@ def offload_requested(host_offload: Optional[bool],
   return bool(host_offload)
 
 
+def pinned_host_supported(device=None) -> bool:
+  """Capability probe: can this backend place arrays in pinned host
+  memory at all? Distinguishes 'the platform cannot offload' (fall back
+  / skip) from 'offload regressed on a platform that can' (fail loudly)
+  — graft dryruns and platform-conditional tests key off it."""
+  import jax
+  dev = device or jax.devices()[0]
+  try:
+    return any(getattr(m, 'kind', None) == 'pinned_host'
+               for m in dev.addressable_memories())
+  except Exception:
+    pass
+  try:  # older jax without addressable_memories: probe with a put
+    import numpy as np
+    from jax.sharding import SingleDeviceSharding
+    jax.device_put(np.zeros((1,), np.float32),
+                   SingleDeviceSharding(dev, memory_kind='pinned_host'))
+    return True
+  except Exception:
+    return False
+
+
 def maybe_pin_host(build_fn, host_offload: Optional[bool]):
   """Run ``build_fn()`` (which must place an array in pinned host
   memory) tolerating platforms without memory kinds: auto mode returns

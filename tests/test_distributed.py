@@ -914,6 +914,8 @@ def test_dist_feature_spill_parity(mesh, dist_datasets):
 
 
 def test_dist_feature_spill_cold_get_roundtrip(mesh, dist_datasets):
+  from fixtures import skip_unless_pinned_host
+  skip_unless_pinned_host()
   # the rpc-callee surface (legacy host-phase path): cold_get(partition,
   # ids) must serve exactly the rows lookup() would have resolved for
   # that partition. Offloaded stores free this state and refuse.
@@ -960,6 +962,8 @@ def test_dist_feature_bucket_cap_parity(mesh, dist_datasets):
 
 def test_dist_hetero_train_step_capped_offloaded_spill(
     tmp_path_factory, mesh):
+  from fixtures import skip_unless_pinned_host
+  skip_unless_pinned_host()
   """VERDICT r4 next #7: bucket_cap + host-offloaded spill COMBINED in
   the fused hetero train step (IGBH shape: typed stores, rgnn, fused
   sampling+gather+update). The in-program drain makes the combination
@@ -1055,6 +1059,8 @@ def test_dist_feature_bucket_cap_mutation_after_trace_rejected(
 
 
 def test_dist_feature_host_offload_active_and_parity(mesh, dist_datasets):
+  from fixtures import skip_unless_pinned_host
+  skip_unless_pinned_host()
   # spilled store auto-builds the pinned-host cold block; lookup parity
   # vs the resident store with NO host phase (cold served in-program)
   df = DistFeature.from_dist_datasets(mesh, dist_datasets,
@@ -1075,6 +1081,8 @@ def test_dist_feature_host_offload_active_and_parity(mesh, dist_datasets):
 
 def test_dist_train_step_with_host_offloaded_spill(mesh, part_dir,
                                                    dist_datasets):
+  from fixtures import skip_unless_pinned_host
+  skip_unless_pinned_host()
   # the fused one-program step accepts a spilled store once the cold
   # block is host-offloaded, and trains IDENTICALLY to resident
   import optax
@@ -1108,6 +1116,8 @@ def test_dist_train_step_with_host_offloaded_spill(mesh, part_dir,
 
 def test_dist_hetero_train_step_with_host_offloaded_spill(
     tmp_path_factory, mesh):
+  from fixtures import skip_unless_pinned_host
+  skip_unless_pinned_host()
   # the fused hetero (IGBH-path) step trains spilled per-type stores
   # via the pinned-host cold blocks, identically to resident stores
   import optax

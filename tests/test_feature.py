@@ -53,6 +53,8 @@ def test_dtype_cast_bf16():
 
 
 def test_gather_mixed_host_offload_parity():
+  from fixtures import skip_unless_pinned_host
+  skip_unless_pinned_host()
   # pinned-host cold block served in-jit == plain values, across the
   # hot/cold boundary and for the all-cold (hot_count=0) table
   import jax.numpy as jnp

@@ -230,6 +230,8 @@ def test_sharded_feature_spill_legacy_host_phase_parity(mesh):
 
 
 def test_fused_train_step_with_host_offloaded_spill(mesh):
+  from fixtures import skip_unless_pinned_host
+  skip_unless_pinned_host()
   # the pinned-host cold block (reference unified_tensor.cu:202-231 UVA
   # analog) lets the fused SPMD step train a spilled store with results
   # IDENTICAL to the device-resident run

@@ -97,6 +97,33 @@ def test_meter_report_auto_scales_unit():
   assert ThroughputMeter('req').report() == '0.00 req/s'
 
 
+def test_prefetch_joins_worker_on_abandon():
+  """An abandoned/closed consumer must stop AND JOIN the prefetch
+  worker; before the fix the daemon thread (and the batch references it
+  held) leaked until process exit."""
+  from glt_tpu.utils.prefetch import PrefetchIterator
+
+  def endless():
+    i = 0
+    while True:
+      yield i
+      i += 1
+
+  p = PrefetchIterator(endless(), depth=2)
+  it = iter(p)
+  assert next(it) == 0
+  assert p.worker_thread is not None and p.worker_thread.is_alive()
+  it.close()  # abandon mid-stream -> generator finally -> stop + join
+  assert not p.worker_thread.is_alive()
+
+
+def test_prefetch_joins_worker_on_exhaustion():
+  from glt_tpu.utils.prefetch import prefetch
+  p = prefetch(iter(range(5)), depth=2)
+  assert list(p) == [0, 1, 2, 3, 4]
+  assert not p.worker_thread.is_alive()
+
+
 def test_mesh_helpers():
   from glt_tpu.parallel import make_mesh, replicated, row_sharded
   mesh = make_mesh(8)
