@@ -1,0 +1,201 @@
+"""Streaming-update benchmark: ingestion throughput, compaction
+latency, and serving latency *during* compactions.
+
+Three phases over one engine (StreamSampler + SnapshotManager):
+
+  1. **ingest**: stage ``--updates`` edge ops through the
+     StreamIngestor (overlay refresh on, compaction off) -> ops/s for
+     the stage+refresh write path;
+  2. **compact**: repeated delta fills + flushes -> compaction latency
+     stats (mean/max ms) and the zero-recompile certificate across all
+     swaps;
+  3. **serve-under-churn**: client threads hammer ``infer`` while a
+     writer thread streams updates and compactions fire by policy ->
+     p50/p99 with the mutation engine live (the number a production
+     deployment actually cares about).
+
+Prints one JSON line (the CI smoke-bench job uploads it as an
+artifact, same contract as bench_serving.py).
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--num-nodes', type=int,
+                  default=int(os.environ.get('GLT_BENCH_NODES', 24_000)))
+  ap.add_argument('--avg-degree', type=int, default=25)
+  ap.add_argument('--feat-dim', type=int, default=100)
+  ap.add_argument('--hidden', type=int, default=128)
+  ap.add_argument('--fanout', default='10,5')
+  ap.add_argument('--buckets', default='8,32')
+  ap.add_argument('--delta-window', type=int, default=8)
+  ap.add_argument('--delta-capacity', type=int, default=8192)
+  ap.add_argument('--updates', type=int, default=4096,
+                  help='edge ops for the ingest phase')
+  ap.add_argument('--ingest-batch', type=int, default=64,
+                  help='edges per insert_edges call')
+  ap.add_argument('--compactions', type=int, default=4)
+  ap.add_argument('--clients', type=int, default=2)
+  ap.add_argument('--serve-seconds', type=float, default=4.0)
+  ap.add_argument('--max-request', type=int, default=16)
+  args = ap.parse_args()
+
+  from glt_tpu.utils.backend import force_backend
+  force_backend()
+  import jax
+
+  from examples.common import synthetic_products
+  from glt_tpu.models import GraphSAGE
+  from glt_tpu.serving import InferenceEngine, ServingMetrics
+  from glt_tpu.stream import (
+      CompactionPolicy, SnapshotManager, StreamIngestor, StreamSampler,
+  )
+
+  fanout = [int(x) for x in args.fanout.split(',')]
+  buckets = [int(x) for x in args.buckets.split(',')]
+  ds, num_classes = synthetic_products(
+      num_nodes=args.num_nodes, avg_degree=args.avg_degree,
+      feat_dim=args.feat_dim)
+  model = GraphSAGE(hidden_features=args.hidden,
+                    out_features=num_classes, num_layers=len(fanout))
+
+  manager = SnapshotManager(
+      ds.get_graph().topo, ds.get_node_feature(),
+      delta_capacity=args.delta_capacity)
+  sampler = StreamSampler(manager, fanout,
+                          delta_window=args.delta_window, seed=0)
+  engine = InferenceEngine(ds, model, None, fanout, sampler=sampler,
+                           buckets=buckets)
+  engine.init_params(jax.random.key(0))
+  t0 = time.perf_counter()
+  engine.warmup()
+  warmup_s = time.perf_counter() - t0
+  warm = engine.compile_stats()
+  warm_traces = sampler.trace_count
+  rng = np.random.default_rng(0)
+
+  # -- phase 1: ingest throughput (stage + overlay refresh) --------------
+  ingestor = StreamIngestor(
+      manager, sampler=sampler, engine=engine,
+      policy=CompactionPolicy(occupancy_threshold=2.0,
+                              max_staleness_s=0.0))
+  n_batches = max(args.updates // args.ingest_batch, 1)
+  srcs = rng.integers(0, args.num_nodes, (n_batches, args.ingest_batch))
+  dsts = rng.integers(0, args.num_nodes, (n_batches, args.ingest_batch))
+  t0 = time.perf_counter()
+  for b in range(n_batches):
+    ingestor.insert_edges(srcs[b], dsts[b])
+  ingest_s = time.perf_counter() - t0
+  ingest_ops = n_batches * args.ingest_batch
+  ingestor.flush()
+
+  # -- phase 2: compaction latency ---------------------------------------
+  lat = []
+  for _ in range(args.compactions):
+    ingestor.insert_edges(
+        rng.integers(0, args.num_nodes, args.ingest_batch),
+        rng.integers(0, args.num_nodes, args.ingest_batch))
+    ingestor.update_features(
+        rng.integers(0, args.num_nodes, 8),
+        rng.normal(size=(8, args.feat_dim)).astype(np.float32))
+    info = ingestor.flush()
+    lat.append(info['compaction_s'] * 1e3)
+
+  # -- phase 3: serving latency during compactions -----------------------
+  metrics = ServingMetrics()
+  ingestor.metrics = metrics
+  ingestor.policy = CompactionPolicy(
+      occupancy_threshold=float(args.ingest_batch * 4)
+      / args.delta_capacity,
+      max_staleness_s=1e9)
+  stop = threading.Event()
+  errors: list = []
+  compactions_before_serve = manager.compactions
+
+  def writer():
+    wrng = np.random.default_rng(99)
+    while not stop.is_set():
+      try:
+        ingestor.insert_edges(
+            wrng.integers(0, args.num_nodes, args.ingest_batch),
+            wrng.integers(0, args.num_nodes, args.ingest_batch))
+      except BaseException as e:  # noqa: BLE001 — surfaced in report
+        errors.append(f'writer: {e!r}')
+        return
+      time.sleep(0.002)
+
+  def client(rank):
+    crng = np.random.default_rng(rank)
+    deadline = time.monotonic() + args.serve_seconds
+    while time.monotonic() < deadline:
+      n = int(crng.integers(1, args.max_request + 1))
+      ids = ((crng.random(n) ** 2) * args.num_nodes).astype(np.int64)
+      t = time.perf_counter()
+      try:
+        out = engine.infer(ids)
+        assert out.shape[0] == n
+      except BaseException as e:  # noqa: BLE001
+        errors.append(f'client {rank}: {e!r}')
+        return
+      metrics.record_request(time.perf_counter() - t, n)
+
+  wt = threading.Thread(target=writer)
+  cts = [threading.Thread(target=client, args=(r,))
+         for r in range(args.clients)]
+  wt.start()
+  for t in cts:
+    t.start()
+  for t in cts:
+    t.join()
+  stop.set()
+  wt.join()
+  snap = metrics.snapshot(cache=engine.cache)
+  end = engine.compile_stats()
+
+  report = {
+      'bench': 'stream',
+      'ingest_ops_per_sec': round(ingest_ops / max(ingest_s, 1e-9), 1),
+      'ingest_batch': args.ingest_batch,
+      'compaction_ms_mean': round(float(np.mean(lat)), 2),
+      'compaction_ms_max': round(float(np.max(lat)), 2),
+      'compactions_total': manager.compactions,
+      'snapshot_version': manager.current().version,
+      'serve_requests': snap['requests'],
+      'serve_qps': round(snap['qps'], 2),
+      'serve_p50_ms': round(snap['latency_p50_ms'], 3),
+      'serve_p99_ms': round(snap['latency_p99_ms'], 3),
+      'cache_hit_rate': round(snap['cache_hit_rate'], 4),
+      'compactions_during_serve':
+          manager.compactions - compactions_before_serve,
+      'steady_state_recompiles': (
+          sum(end['forward_traces'].values())
+          - sum(warm['forward_traces'].values())
+          + sampler.trace_count - warm_traces),
+      'capacity_growths': manager.capacity_growths,
+      'warmup_seconds': round(warmup_s, 2),
+      'errors': errors,
+      'config': {
+          'num_nodes': args.num_nodes, 'fanout': fanout,
+          'buckets': buckets, 'delta_window': args.delta_window,
+          'delta_capacity': args.delta_capacity,
+          'updates': ingest_ops, 'clients': args.clients,
+      },
+  }
+  print(json.dumps(report))
+  if errors:
+    sys.exit(1)
+
+
+if __name__ == '__main__':
+  main()

@@ -24,3 +24,4 @@ from . import partition  # noqa: F401
 from . import parallel  # noqa: F401
 from . import distributed  # noqa: F401
 from . import serving  # noqa: F401
+from . import stream  # noqa: F401

@@ -161,6 +161,14 @@ class Feature:
     return self.shape[1]
 
   @property
+  def id_space(self) -> int:
+    """Size of the id domain lookups accept: the id2index table length
+    when an id map is configured (partitioned stores take GLOBAL ids),
+    else the row count."""
+    return (self._id2index.shape[0] if self._id2index is not None
+            else self.num_rows)
+
+  @property
   def fully_device_resident(self) -> bool:
     return self.hot_count >= self.num_rows
 
@@ -256,6 +264,52 @@ class Feature:
     lanes = np.nonzero(cold)
     if lanes[0].size:
       out[lanes] = self.gather_cold_host(nodes[lanes]).astype(np_dtype)
+    return out
+
+  def with_updated_rows(self, ids, values) -> 'Feature':
+    """Functional row update: a NEW Feature sharing every buffer with
+    this one except the updated rows — the snapshot-isolation primitive
+    of the stream subsystem (readers of the old Feature keep seeing the
+    old values; jitted gathers against either are shape-identical, so
+    swapping costs no recompile).
+
+    Hot rows ride jax's functional ``.at[].set`` (copy-on-write of the
+    device block); cold rows copy the host block once per call, so
+    confine streams with heavy cold-row churn to split_ratio=1.0
+    stores. Offloaded (pinned-host) cold blocks reject cold-row updates
+    — re-pinning per update would thrash the very placement the offload
+    exists for.
+    """
+    self.lazy_init()
+    ids = as_numpy(ids).astype(np.int64).reshape(-1)
+    values = as_numpy(values)
+    if values.ndim == 1:
+      values = values[:, None]
+    assert values.shape == (ids.shape[0], self.feature_dim), (
+        f'expected {(ids.shape[0], self.feature_dim)} update block, '
+        f'got {values.shape}')
+    rows = self.map_ids(ids)
+    if isinstance(rows, jax.Array):
+      rows = as_numpy(rows)
+    rows = rows.astype(np.int64)
+    if rows.size and (rows.min() < 0 or rows.max() >= self.num_rows):
+      raise ValueError(
+          f'feature row out of range [0, {self.num_rows})')
+    out = Feature.__new__(Feature)
+    out.__dict__.update(self.__dict__)
+    hot_sel = rows < self.hot_count
+    if hot_sel.any():
+      np_dtype = np.dtype(jnp.dtype(self.dtype))
+      out._hot = self._hot.at[jnp.asarray(rows[hot_sel])].set(
+          jnp.asarray(values[hot_sel].astype(np_dtype)))
+    if (~hot_sel).any():
+      assert self.cold_array is None, (
+          'cold-row updates are unsupported on host-offloaded stores; '
+          'use host_offload=False or keep updated rows in the hot '
+          'split')
+      cold = self._cold.copy()
+      cold[rows[~hot_sel] - self.hot_count] = values[~hot_sel]
+      out._cold = cold
     return out
 
   def __getitem__(self, ids) -> np.ndarray:

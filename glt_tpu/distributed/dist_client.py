@@ -39,6 +39,28 @@ def async_request_server(server_rank: int, method: str, *args, **kwargs):
   return _clients[server_rank].async_request(method, *args, **kwargs)
 
 
+def apply_delta(server_rank: int, ins=None, dels=None, feat_ids=None,
+                feat_rows=None, compact: bool = False) -> dict:
+  """Post live graph/feature updates to one partition server (its
+  ``DistServer.apply_delta``). ``ins``/``dels`` are [2, n] edge blocks
+  in that partition's local ids; ``compact=True`` forces the server to
+  fold the delta into a fresh snapshot immediately."""
+  import numpy as np
+
+  from ..channel import pack_message
+  msg = {}
+  if ins is not None:
+    msg['ins'] = np.asarray(ins, np.int64)
+  if dels is not None:
+    msg['dels'] = np.asarray(dels, np.int64)
+  if feat_ids is not None:
+    msg['feat_ids'] = np.asarray(feat_ids, np.int64)
+    msg['feat_rows'] = np.asarray(feat_rows)
+  if compact:
+    msg['compact'] = np.ones(1, np.int8)
+  return request_server(server_rank, 'apply_delta', pack_message(msg))
+
+
 def barrier() -> None:
   """Client-group barrier via server 0's built-in (reference rpc
   role-scoped barrier)."""

@@ -43,6 +43,9 @@ class DistServer:
     self._channels: Dict[str, object] = {}
     self._ends_seen: Dict[str, int] = {}
     self._epochs: Dict[str, int] = {}
+    self._stream = None  # lazy StreamIngestor for apply_delta
+    self._stream_lock = threading.Lock()
+    self._stream_bound_version = 0
     self._exit = threading.Event()
 
   # -- control plane -----------------------------------------------------
@@ -169,6 +172,82 @@ class DistServer:
       part = pb[ids]
     return pack_message({'partition': part})
 
+  # -- live updates (stream subsystem) -----------------------------------
+
+  def _stream_ingestor(self, delta_capacity: int = 4096):
+    # locked: RpcServer serves each connection on its own thread, and
+    # two racing first-calls would each build a snapshot chain off the
+    # startup topology — one client's updates silently discarded
+    with self._stream_lock:
+      if self._stream is None:
+        assert not self.dataset.is_hetero, (
+            'apply_delta is homogeneous-only for now (hetero needs '
+            'per-edge-type delta buffers)')
+        from ..stream import SnapshotManager, StreamIngestor
+        g = self.dataset.get_graph()
+        manager = SnapshotManager(
+            g.topo, self.dataset.get_node_feature(),
+            delta_capacity=delta_capacity)
+        self._stream = StreamIngestor(manager)
+      return self._stream
+
+  def apply_delta(self, delta_bytes: bytes) -> dict:
+    """Apply live updates to THIS partition's dataset (the fan-out arm
+    of the stream subsystem: a coordinator shards updates by partition
+    book and posts each server its slice).
+
+    Payload (packed TensorMap): optional ``ins`` / ``dels`` ``[2, n]``
+    edge blocks in partition-LOCAL ids, optional ``feat_ids`` +
+    ``feat_rows`` feature updates, optional ``compact`` flag (any
+    1-element array; forces compaction now instead of the policy).
+
+    On compaction the server's ``dataset.graph`` / ``node_features``
+    rebind to the new snapshot, so the data-plane RPCs
+    (get_node_feature, get_edge_index, ...) and any producer created
+    afterwards serve the fresh graph. Producers already running keep
+    their epoch's snapshot until their next epoch restart — staleness
+    at epoch granularity, the same bound trainers already accept.
+    """
+    msg = unpack_message(delta_bytes)
+    stream = self._stream_ingestor()
+    v0 = stream.manager.current().version
+    applied = {'inserts': 0, 'deletes': 0, 'feature_rows': 0}
+    if 'ins' in msg:
+      ins = as_numpy(msg['ins'])
+      applied['inserts'] = stream.insert_edges(ins[0], ins[1])
+    if 'dels' in msg:
+      dels = as_numpy(msg['dels'])
+      applied['deletes'] = stream.delete_edges(dels[0], dels[1])
+    if 'feat_ids' in msg:
+      applied['feature_rows'] = stream.update_features(
+          msg['feat_ids'], msg['feat_rows'])
+    if 'compact' in msg:
+      stream.flush()
+    else:
+      stream.maybe_compact()
+    # rebind keyed on the VERSION, not on whether this call's explicit
+    # flush compacted: the staging calls above auto-compact through the
+    # ingestor policy, and another client's call may have swapped too
+    version = stream.manager.current().version
+    with self._stream_lock:
+      if version != self._stream_bound_version:
+        from ..data import Graph
+        snap = stream.manager.current()
+        old = self.dataset.get_graph()
+        self.dataset.graph = Graph(snap.topo, mode=old.mode,
+                                   device=old.device)
+        if snap.feature is not None:
+          self.dataset.node_features = snap.feature
+        self._stream_bound_version = snap.version
+        version = snap.version
+    return {
+        'applied': applied,
+        'version': version,
+        'pending': stream.edges.size + (stream.features.size
+                                        if stream.features else 0),
+        'compacted': version > v0,
+    }
+
   # -- lifecycle ---------------------------------------------------------
 
   def exit(self) -> bool:
@@ -207,7 +286,7 @@ def init_server(num_servers: int, num_clients: int, server_rank: int,
                'start_new_epoch_sampling', 'fetch_one_sampled_message',
                'get_node_feature', 'get_node_label', 'get_tensor_size',
                'get_edge_index', 'get_edge_size',
-               'get_node_partition_id', 'exit'):
+               'get_node_partition_id', 'apply_delta', 'exit'):
     _rpc_server.register(name, getattr(_server, name))
   _rpc_server.start()  # accept only after all callees exist
   return _server

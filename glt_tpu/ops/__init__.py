@@ -7,6 +7,7 @@ from .negative import edge_in_csr, random_negative_sample, NegativeOutput
 from .subgraph import induced_subgraph, SubGraph
 from .stitch import stitch_rows
 from .superstep import superstep, scan_consume
+from .delta import delta_one_hop, tombstone_mask
 
 __all__ = [
     'NeighborOutput', 'sample_neighbors', 'sample_neighbors_weighted',
@@ -16,4 +17,5 @@ __all__ = [
     'induced_subgraph', 'SubGraph',
     'stitch_rows',
     'superstep', 'scan_consume',
+    'delta_one_hop', 'tombstone_mask',
 ]

@@ -50,8 +50,13 @@ class EmbeddingCache:
 
   @property
   def hit_rate(self) -> float:
-    total = self.hits + self.misses
-    return self.hits / total if total else 0.0
+    # snapshot both counters under the lock: reading them unlocked
+    # against a concurrent lookup() can pair a new `hits` with a stale
+    # `misses` (or vice versa) — a torn, even >1.0, ratio
+    with self._lock:
+      hits, misses = self.hits, self.misses
+    total = hits + misses
+    return hits / total if total else 0.0
 
   # -- lookup / insert ---------------------------------------------------
 
@@ -148,9 +153,14 @@ class EmbeddingCache:
 
   def stats(self) -> dict:
     with self._lock:
+      total = self.hits + self.misses
       return {
           'size': len(self._data), 'capacity': self.capacity,
           'hits': self.hits, 'misses': self.misses,
-          'hit_rate': self.hit_rate, 'evictions': self.evictions,
+          # computed from the counters already under THIS lock hold —
+          # self.hit_rate would deadlock (non-reentrant lock) and a
+          # re-read could tear against a concurrent lookup()
+          'hit_rate': self.hits / total if total else 0.0,
+          'evictions': self.evictions,
           'invalidations': self.invalidations,
       }

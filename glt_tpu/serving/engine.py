@@ -56,6 +56,10 @@ class InferenceEngine:
     model_version: version tag for cache keys; ``set_params`` bumps it.
     seed: sampler RNG seed (serving samples fresh neighborhoods per
       request, matching the reference's inference-time sampling).
+    sampler: inject a pre-built sampler instead of the default
+      NeighborSampler over ``data.graph`` — how live-update serving
+      plugs in a :class:`~glt_tpu.stream.StreamSampler` (whose jitted
+      programs survive snapshot swaps; see ``update_snapshot``).
   """
 
   def __init__(self, data: Dataset, model, params,
@@ -66,7 +70,8 @@ class InferenceEngine:
                model_version: int = 0,
                seed: Optional[int] = 0,
                apply_fn: Optional[Callable] = None,
-               with_edge: bool = False):
+               with_edge: bool = False,
+               sampler=None):
     assert not isinstance(data.graph, dict), (
         'serving engine is homogeneous-only for now (hetero serving '
         'needs per-type bucket grids)')
@@ -78,7 +83,7 @@ class InferenceEngine:
     self.model_version = int(model_version)
     self.cache = cache if cache is not None \
         else EmbeddingCache(cache_capacity)
-    self.sampler = NeighborSampler(
+    self.sampler = sampler if sampler is not None else NeighborSampler(
         data.graph, list(num_neighbors), edge_dir=data.edge_dir,
         with_edge=with_edge, seed=seed)
     self._apply_fn = apply_fn or (
@@ -239,3 +244,40 @@ class InferenceEngine:
     """Feature/graph update hook: drop cached embeddings of ``ids``
     across all versions."""
     return self.invalidate(ids=ids)
+
+  def update_snapshot(self, snapshot, touched_ids=None,
+                      expand_in_neighbors: bool = False) -> int:
+    """Swap serving onto a new stream snapshot (glt_tpu.stream).
+
+    Under the engine lock (serialized against in-flight infer): install
+    the snapshot's Feature as the gather source, then fan the touched
+    node ids into :meth:`EmbeddingCache.invalidate` so no embedding
+    computed against the old graph/features is ever served again. An
+    in-flight request that sampled the old snapshot finishes on it
+    (RCU) and any stale rows it caches are swept here, because the
+    invalidation runs strictly after the swap.
+
+    Args:
+      snapshot: a :class:`glt_tpu.stream.Snapshot`; its ``feature``
+        (when not None) replaces ``data.node_features``.
+      touched_ids: node ids whose neighborhoods/features changed; None
+        invalidates the whole cache (conservative fallback).
+      expand_in_neighbors: additionally invalidate the reverse-layout
+        1-hop neighborhood of the touched ids (``Snapshot.
+        expand_affected`` via the CSC view for a CSR base) — the nodes
+        whose cached embeddings *aggregate over* a touched node.
+
+    Returns the number of cache entries dropped.
+    """
+    with self._lock:
+      if snapshot.feature is not None:
+        self.data.node_features = snapshot.feature
+      if touched_ids is None:
+        return self.cache.invalidate()
+      ids = as_numpy(touched_ids).astype(np.int64).reshape(-1)
+      if expand_in_neighbors and ids.size:
+        ids = snapshot.expand_affected(ids)
+      ids = ids[(ids >= 0) & (ids < self.num_nodes)]
+      if ids.size == 0:
+        return 0
+      return self.cache.invalidate(ids=ids.tolist())

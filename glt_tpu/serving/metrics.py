@@ -82,6 +82,11 @@ class ServingMetrics:
     self.batches = 0
     self.batched_ids = 0
     self.batch_capacity = 0
+    # gauges: last-value-wins instruments for state (vs the monotonic
+    # counters above) — snapshot version, delta occupancy, compaction
+    # latency... The stream ingestor publishes here so serving and
+    # streaming share ONE observability surface.
+    self._gauges: dict = {}
     self._t0 = time.perf_counter()
 
   def record_request(self, latency_s: float, num_ids: int = 1) -> None:
@@ -103,6 +108,14 @@ class ServingMetrics:
   def record_rejected(self) -> None:
     with self._lock:
       self.rejected += 1
+
+  def set_gauge(self, name: str, value: float) -> None:
+    with self._lock:
+      self._gauges[str(name)] = float(value)
+
+  def get_gauge(self, name: str, default: float = 0.0) -> float:
+    with self._lock:
+      return self._gauges.get(name, default)
 
   @property
   def elapsed(self) -> float:
@@ -133,6 +146,7 @@ class ServingMetrics:
           'batch_fill_ratio': self.batch_fill_ratio,
           'timeouts': self.timeouts,
           'rejected': self.rejected,
+          'gauges': dict(self._gauges),
       }
     if cache is not None:
       out['cache'] = cache.stats()

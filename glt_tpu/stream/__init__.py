@@ -1,0 +1,35 @@
+"""Online graph & feature mutation engine: delta buffers, versioned
+snapshots, cache-coherent serving.
+
+The write path is::
+
+  writers --> EdgeDeltaBuffer / FeatureDeltaBuffer   (stage, µs)
+                  |-- SnapshotManager.build_overlay  (refresh: static-
+                  |                                   shape device CSR
+                  |                                   overlays)
+                  `-- StreamIngestor ----------------(compact: merge to
+                         |                            a fresh sorted CSR,
+                         |                            RCU swap)
+                         |-- StreamSampler.set_overlay / snapshot swap
+                         `-- InferenceEngine.update_snapshot
+                                `-- EmbeddingCache.invalidate(touched)
+
+and the read path stays on the immutable, locality-sorted CSR the
+samplers were built for — delta visibility costs one fixed-width window
+per hop, never a recompile. See docs/streaming.md for the consistency
+model (snapshot isolation, staleness bounds, window sizing).
+"""
+from .delta import (  # noqa: F401
+    DeltaOverflow, EdgeDeltaBuffer, EdgeDeltaCut, FeatureDeltaBuffer,
+    FeatureDeltaCut,
+)
+from .ingest import CompactionPolicy, StreamIngestor  # noqa: F401
+from .sampler import StreamSampler  # noqa: F401
+from .snapshot import Snapshot, SnapshotManager  # noqa: F401
+
+__all__ = [
+    'DeltaOverflow', 'EdgeDeltaBuffer', 'EdgeDeltaCut',
+    'FeatureDeltaBuffer', 'FeatureDeltaCut',
+    'CompactionPolicy', 'StreamIngestor',
+    'StreamSampler', 'Snapshot', 'SnapshotManager',
+]

@@ -1,0 +1,291 @@
+"""StreamIngestor — the background applier that turns buffered updates
+into visible graph state.
+
+The write path is three stages with different latencies:
+
+  1. **stage** (microseconds): `insert_edges` / `delete_edges` /
+     `update_features` append into the host delta buffers;
+  2. **refresh** (sub-millisecond, default synchronous): the pending
+     edge set is rebuilt into the static-shape device overlays, making
+     topology changes visible to the very next sample with zero
+     recompiles;
+  3. **compact** (the heavy step): the drained delta merges into a
+     fresh CSR snapshot, features apply, the serving cache invalidates
+     touched nodes, and the overlay resets to the residual pending set.
+
+Compaction fires from the auto-policy (delta occupancy or staleness
+thresholds, checked by the background thread and opportunistically on
+every staging call) or explicitly via :meth:`flush`. Observability
+rides the shared :class:`~glt_tpu.serving.metrics.ServingMetrics`
+gauges — no parallel metrics class.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..serving.metrics import ServingMetrics
+from ..utils.profile import Timer
+from .delta import EdgeDeltaBuffer, FeatureDeltaBuffer
+from .sampler import StreamSampler
+from .snapshot import SnapshotManager
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class CompactionPolicy:
+  """When the ingestor folds the delta into a fresh snapshot.
+
+  occupancy_threshold: compact once pending ops reach this fraction of
+    the delta capacity (bounds truncation risk of the per-node windows
+    and keeps headroom for write bursts).
+  max_staleness_s: compact once the oldest pending op is this old —
+    feature updates are only visible post-compaction, so this IS the
+    feature-freshness bound (see docs/streaming.md).
+  min_interval_s: floor between auto-compactions (swap hygiene under
+    sustained write load; explicit flush() ignores it).
+  """
+  occupancy_threshold: float = 0.5
+  max_staleness_s: float = 30.0
+  min_interval_s: float = 0.0
+
+
+class StreamIngestor:
+  """Owns the delta buffers and drives refresh + compaction.
+
+  Args:
+    manager: the snapshot chain.
+    sampler: optional StreamSampler to keep overlay-fresh.
+    engine: optional serving InferenceEngine; on compaction its
+      ``update_snapshot`` swaps features and invalidates touched cache
+      entries (with optional reverse-adjacency expansion).
+    metrics: optional shared ServingMetrics; the ingestor publishes
+      gauges (snapshot_version, delta_occupancy, compactions,
+      last_compaction_ms, ...) into it.
+    auto_refresh: rebuild the device overlay synchronously on every
+      staging call (default) — freshest reads, but each rebuild is
+      O(num_rows) host work plus an indptr upload, so on very large
+      node spaces prefer False and let the background thread refresh
+      on its poll cadence (higher ingest throughput, staleness bounded
+      by ``poll_interval_s``). Unchanged pending sets never rebuild
+      either way (memoized on the buffer's mutation_seq).
+    expand_invalidation: pass touched ids through the snapshot's
+      reverse-layout 1-hop expansion before cache invalidation.
+  """
+
+  def __init__(self, manager: SnapshotManager,
+               sampler: Optional[StreamSampler] = None,
+               engine=None,
+               policy: Optional[CompactionPolicy] = None,
+               metrics: Optional[ServingMetrics] = None,
+               feature_capacity: Optional[int] = None,
+               auto_refresh: bool = True,
+               expand_invalidation: bool = False):
+    self.manager = manager
+    self.sampler = sampler
+    self.engine = engine
+    self.policy = policy or CompactionPolicy()
+    self.metrics = metrics
+    self.auto_refresh = auto_refresh
+    self.expand_invalidation = expand_invalidation
+    self.edges = EdgeDeltaBuffer(capacity=manager.delta_capacity,
+                                 num_src=manager.num_src_nodes,
+                                 num_dst=manager.num_dst_nodes)
+    feat = manager.current().feature
+    # feature staging is constructed against the actual store geometry
+    # so bad updates (wrong row width, topology-only stream) fail at
+    # the caller's staging call — deferred to compaction they would
+    # wedge the stream (failed flush restages the same bad cut forever)
+    # bound by the feature's ID SPACE, not its row count: a
+    # partitioned store takes global ids through its id2index map
+    # (ownership of each id is checked in update_features)
+    self.features = FeatureDeltaBuffer(
+        capacity=feature_capacity or manager.delta_capacity,
+        num_nodes=feat.id_space,
+        feature_dim=feat.feature_dim) if feat is not None else None
+    self._compact_lock = threading.Lock()
+    self._last_compaction_ts: Optional[float] = None
+    self._stop = threading.Event()
+    self._thread: Optional[threading.Thread] = None
+    self._publish_gauges()
+
+  # -- write API ---------------------------------------------------------
+
+  def insert_edges(self, src, dst) -> int:
+    n = self.edges.insert_edges(src, dst)
+    self._after_stage(refresh=True)
+    return n
+
+  def delete_edges(self, src, dst) -> int:
+    n = self.edges.delete_edges(src, dst)
+    self._after_stage(refresh=True)
+    return n
+
+  def update_features(self, ids, values) -> int:
+    if self.features is None:
+      raise ValueError(
+          'this stream carries no Feature (SnapshotManager was built '
+          'without one); feature updates have nowhere to land')
+    # ownership check at STAGING time: on a partitioned store an
+    # unowned global id maps to an out-of-range local row — deferred
+    # to compaction it would fail the merge, restage, and wedge the
+    # stream (the same failure class the width check guards)
+    feat = self.manager.current().feature
+    ids_np = np.asarray(ids, np.int64).reshape(-1)
+    if ids_np.size and (int(ids_np.min()) < 0
+                        or int(ids_np.max()) >= feat.id_space):
+      raise ValueError(
+          f'feature id out of range [0, {feat.id_space})')
+    rows = np.asarray(feat.map_ids(ids_np))
+    bad = ids_np[(rows < 0) | (rows >= feat.num_rows)]
+    if bad.size:
+      raise ValueError(
+          f'feature ids not owned by this store (local rows '
+          f'[0, {feat.num_rows})): {bad[:8].tolist()}')
+    n = self.features.update_rows(ids, values)
+    # feature rows only land at compaction (snapshot isolation): no
+    # overlay refresh, but the staleness policy may fire right away
+    self._after_stage(refresh=False)
+    return n
+
+  def _after_stage(self, refresh: bool) -> None:
+    if refresh and self.auto_refresh and self.sampler is not None:
+      self.sampler.refresh_overlay(self.edges)
+    self._publish_gauges()
+    self.maybe_compact()
+
+  # -- compaction --------------------------------------------------------
+
+  def _due(self) -> bool:
+    p = self.policy
+    if self._last_compaction_ts is not None and p.min_interval_s > 0:
+      if time.monotonic() - self._last_compaction_ts < p.min_interval_s:
+        return False
+    feat_occ = self.features.occupancy if self.features else 0.0
+    if (self.edges.occupancy >= p.occupancy_threshold
+        or feat_occ >= p.occupancy_threshold):
+      return True
+    staleness = max(self.edges.staleness_s,
+                    self.features.staleness_s if self.features else 0.0)
+    return p.max_staleness_s > 0 and staleness >= p.max_staleness_s
+
+  def maybe_compact(self):
+    """Compact iff the policy says so; returns the info dict or None."""
+    if not self._due():
+      return None
+    return self.flush()
+
+  def flush(self):
+    """Force a compaction of everything pending; returns the info dict
+    or None when there was nothing to fold."""
+    with self._compact_lock:
+      if self.edges.size == 0 \
+          and (self.features is None or self.features.size == 0):
+        return None
+      t = Timer().start()
+      edge_cut = feat_cut = None
+      try:
+        edge_cut = self.edges.drain()
+        feat_cut = self.features.drain() if self.features else None
+        snap, info = self.manager.compact(edge_cut, feat_cut)
+      except Exception:
+        # failed anywhere past the first drain: put whatever was
+        # drained back so no update is lost
+        if edge_cut is not None:
+          self.edges.restage(edge_cut)
+        if feat_cut is not None:
+          self.features.restage(feat_cut)
+        raise
+      # order matters: (1) new base live for samplers, (2) overlay
+      # drops the folded ops, (3) cache entries computed against the
+      # old snapshot are invalidated LAST — any request racing between
+      # (1) and (3) may cache a stale row, and (3) sweeps it
+      if self.sampler is not None:
+        self.sampler.refresh_overlay(self.edges)
+      if self.engine is not None:
+        info['invalidated'] = self.engine.update_snapshot(
+            snap, touched_ids=info['touched'],
+            expand_in_neighbors=self.expand_invalidation)
+      self._last_compaction_ts = time.monotonic()
+      info['wall_s'] = t.stop()
+      if info['capacity_grown']:
+        logger.info(
+            'stream: edge capacity grew to %d (snapshot v%d) — '
+            'samplers retrace once', info['edge_capacity'],
+            info['version'])
+      self._publish_gauges()
+      return info
+
+  # -- metrics -----------------------------------------------------------
+
+  def _publish_gauges(self) -> None:
+    if self.metrics is None:
+      return
+    m = self.manager
+    self.metrics.set_gauge('snapshot_version', m.current().version)
+    self.metrics.set_gauge('delta_occupancy', self.edges.occupancy)
+    self.metrics.set_gauge(
+        'feature_delta_occupancy',
+        self.features.occupancy if self.features else 0.0)
+    self.metrics.set_gauge('compactions', m.compactions)
+    self.metrics.set_gauge('last_compaction_ms',
+                           m.last_compaction_s * 1e3)
+    self.metrics.set_gauge('edge_capacity', m.edge_capacity)
+    self.metrics.set_gauge('capacity_growths', m.capacity_growths)
+    self.metrics.set_gauge(
+        'ingest_ops_total',
+        self.edges.total_inserts + self.edges.total_deletes
+        + (self.features.total_updates if self.features else 0))
+
+  def stats(self) -> dict:
+    return {
+        'snapshot_version': self.manager.current().version,
+        'compactions': self.manager.compactions,
+        'last_compaction_ms': self.manager.last_compaction_s * 1e3,
+        'edge_capacity': self.manager.edge_capacity,
+        'capacity_growths': self.manager.capacity_growths,
+        'edge_delta': self.edges.stats(),
+        'feature_delta': (self.features.stats()
+                          if self.features else None),
+    }
+
+  # -- background applier ------------------------------------------------
+
+  def start(self, poll_interval_s: float = 0.5) -> 'StreamIngestor':
+    """Run the policy check (and, with auto_refresh=False, the overlay
+    refresh) on a daemon thread."""
+    assert self._thread is None, 'ingestor already started'
+    self._stop.clear()
+
+    def loop():
+      while not self._stop.wait(poll_interval_s):
+        try:
+          if not self.auto_refresh and self.sampler is not None:
+            self.sampler.refresh_overlay(self.edges)
+          self._publish_gauges()
+          self.maybe_compact()
+        except Exception:  # keep the applier alive; surface in logs
+          logger.exception('stream ingest tick failed')
+
+    self._thread = threading.Thread(target=loop, daemon=True,
+                                    name='glt-stream-ingest')
+    self._thread.start()
+    return self
+
+  def stop(self) -> None:
+    self._stop.set()
+    if self._thread is not None:
+      self._thread.join(timeout=10)
+      self._thread = None
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.stop()

@@ -1,0 +1,396 @@
+"""Versioned immutable snapshots with RCU-style swap and delta
+compaction.
+
+A :class:`Snapshot` is one immutable ``(Topology, Feature)`` version
+plus its device-resident, **capacity-padded** CSR arrays. Padding is the
+whole trick: every snapshot's arrays share one static shape
+(``[num_rows + 1]`` indptr, ``[edge_capacity]`` indices), so the stream
+sampler's jitted multi-hop program — which takes them as *arguments*,
+never closure constants — keeps serving across compactions with zero
+steady-state recompiles. Only outgrowing ``edge_capacity`` changes
+shapes (one recompile, reported in the compaction info).
+
+Swap protocol (read-copy-update): readers ``acquire()`` the current
+snapshot, sample against its arrays, then ``release()``. ``compact()``
+publishes the merged snapshot and *retires* the old one; its device
+buffers are freed when the last in-flight reader releases — in-flight
+sampling always finishes on the snapshot it started with.
+
+Compaction itself is host-side and reuses the one battle-tested CSR
+builder in the codebase: the merged COO goes through ``Topology``'s
+constructor (``data/topology._compress`` + ``_sort_within_rows``), so
+the compacted graph is locality-sorted exactly like a cold-start build.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..data.feature import Feature
+from ..data.topology import Topology
+from ..utils import as_numpy
+from .delta import EdgeDeltaBuffer, EdgeDeltaCut, FeatureDeltaCut, \
+    _pair_key
+
+
+def _padded_csr_device(indptr: np.ndarray, indices: np.ndarray,
+                       capacity: int, device=None
+                       ) -> Tuple[jax.Array, jax.Array]:
+  """int32 device (indptr, indices) with indices padded to ``capacity``
+  slots (sentinel -1; valid lanes never read the pad)."""
+  assert indices.shape[0] <= capacity, \
+      f'{indices.shape[0]} edges exceed capacity {capacity}'
+  assert indptr[-1] < np.iinfo(np.int32).max
+  pad = np.full(capacity - indices.shape[0], -1, np.int32)
+  return (jax.device_put(indptr.astype(np.int32), device),
+          jax.device_put(
+              np.concatenate([indices.astype(np.int32), pad]), device))
+
+
+def _delta_csr(src: np.ndarray, dst: np.ndarray, num_rows: int,
+               capacity: int, layout: str, device=None) -> dict:
+  """Build one capacity-padded overlay CSR from (src, dst) pairs,
+  oriented to the base layout's row axis."""
+  row, col = (src, dst) if layout == 'CSR' else (dst, src)
+  order = np.lexsort((col, row))
+  row, col = row[order], col[order]
+  indptr = np.zeros(num_rows + 1, np.int64)
+  np.cumsum(np.bincount(row, minlength=num_rows), out=indptr[1:])
+  return _padded_csr_device(indptr, col, capacity, device)
+
+
+class Snapshot:
+  """One immutable graph/feature version (see module docstring).
+
+  Attributes:
+    version: monotonically increasing snapshot id.
+    topo: host Topology (immutable by convention).
+    feature: node Feature for this version (None when the stream is
+      topology-only); may be shared with the previous snapshot when a
+      compaction carried no feature updates.
+  """
+
+  def __init__(self, version: int, topo: Topology,
+               feature: Optional[Feature],
+               edge_capacity: int, device=None):
+    self.version = int(version)
+    self.topo = topo
+    self.feature = feature
+    self.edge_capacity = int(edge_capacity)
+    indptr, indices = _padded_csr_device(
+        topo.indptr, topo.indices, edge_capacity, device)
+    #: static-shape jit arguments: base CSR of this version
+    self.arrays: Dict[str, jax.Array] = {
+        'indptr': indptr, 'indices': indices}
+    self._refs = 0
+    self._retired = False
+    self._freed = False
+    self._flipped: Optional[Topology] = None
+
+    #: computed once at build (O(N) host scan): samplers consult it per
+    #: call to detect full-window truncation
+    self.max_degree = topo.max_degree
+
+  @property
+  def num_rows(self) -> int:
+    return self.topo.num_rows
+
+  @property
+  def num_edges(self) -> int:
+    return self.topo.num_edges
+
+  @property
+  def freed(self) -> bool:
+    return self._freed
+
+  def _free(self) -> None:
+    """Release device buffers (manager-internal; called once the
+    snapshot is retired and the last reader released). The Feature is
+    NOT freed — it may be shared with the successor snapshot."""
+    if self._freed:
+      return
+    self._freed = True
+    for arr in self.arrays.values():
+      try:
+        arr.delete()
+      except Exception:
+        pass  # backend without explicit delete: GC reclaims
+    self.arrays = {}
+
+  def flipped_topo(self) -> Topology:
+    """The opposite-layout view (CSC for a CSR base), host-side, built
+    once per snapshot — reverse-adjacency probes for cache
+    invalidation fan-out."""
+    if self._flipped is None:
+      self._flipped = self.topo.flip_layout()
+    return self._flipped
+
+  def expand_affected(self, ids: np.ndarray) -> np.ndarray:
+    """ids ∪ their reverse-layout 1-hop neighborhood: with a CSR base
+    ('out' sampling) these are the in-neighbors — every node whose
+    sampled neighborhood can contain an id, i.e. whose cached embedding
+    aggregates over it."""
+    ids = as_numpy(ids).astype(np.int64).reshape(-1)
+    flip = self.flipped_topo()
+    valid = ids[(ids >= 0) & (ids < flip.num_rows)]
+    starts = flip.indptr[valid]
+    ends = flip.indptr[valid + 1]
+    chunks = [ids] + [flip.indices[s:e] for s, e in zip(starts, ends)]
+    return np.unique(np.concatenate(chunks).astype(np.int64))
+
+
+class SnapshotManager:
+  """Owns the snapshot chain, the delta overlays, and compaction.
+
+  Args:
+    topo: the startup Topology (version 0 base).
+    feature: the startup node Feature (optional).
+    delta_capacity: static overlay width = max pending delta ops; the
+      EdgeDeltaBuffer feeding this manager must use the same bound.
+    edge_capacity: static padded edge-array size; defaults to
+      ``num_edges + 4 * delta_capacity`` (headroom for several
+      compactions of pure inserts before a capacity growth —and
+      recompile— is needed).
+    num_nodes: fixed row-space size; streams cannot add node ids past
+      it (pre-size the id space, the standard practice for online
+      recommendation graphs).
+  """
+
+  def __init__(self, topo: Topology, feature: Optional[Feature] = None,
+               *, delta_capacity: int = 4096,
+               edge_capacity: Optional[int] = None,
+               device=None):
+    self.delta_capacity = int(delta_capacity)
+    self.device = device
+    self.edge_capacity = int(
+        edge_capacity if edge_capacity is not None
+        else topo.num_edges + 4 * self.delta_capacity)
+    self._lock = threading.Lock()
+    self._compact_serial = threading.Lock()
+    self._current = Snapshot(0, topo, feature, self.edge_capacity,
+                             device)
+    self._retired: List[Snapshot] = []
+    eids = topo.edge_ids
+    self._next_edge_id = int(eids.max()) + 1 if eids.size else 0
+    self._empty_overlay: Optional[dict] = None
+    self._overlay_cache = None  # ((buffer id, seq, version), overlay)
+    self.compactions = 0
+    self.capacity_growths = 0
+    self.last_compaction_s = 0.0
+
+  # -- geometry ----------------------------------------------------------
+
+  @property
+  def num_nodes(self) -> int:
+    t = self.current().topo
+    return max(t.num_rows, t.num_cols)
+
+  @property
+  def num_src_nodes(self) -> int:
+    """src-axis id bound in (src, dst) orientation (row axis for a CSR
+    base, col axis for CSC) — what edge-delta src endpoints must obey."""
+    t = self.current().topo
+    return t.num_rows if t.layout == 'CSR' else t.num_cols
+
+  @property
+  def num_dst_nodes(self) -> int:
+    t = self.current().topo
+    return t.num_cols if t.layout == 'CSR' else t.num_rows
+
+  @property
+  def layout(self) -> str:
+    return self.current().topo.layout
+
+  # -- RCU read path -----------------------------------------------------
+
+  def current(self) -> Snapshot:
+    return self._current
+
+  def acquire(self) -> Snapshot:
+    with self._lock:
+      snap = self._current
+      snap._refs += 1
+      return snap
+
+  def release(self, snap: Snapshot) -> None:
+    with self._lock:
+      snap._refs -= 1
+      assert snap._refs >= 0, 'unbalanced snapshot release'
+      self._reap_locked()
+
+  def _reap_locked(self) -> None:
+    alive = []
+    for s in self._retired:
+      if s._refs == 0:
+        s._free()
+      else:
+        alive.append(s)
+    self._retired = alive
+
+  @property
+  def num_retired(self) -> int:
+    with self._lock:
+      return len(self._retired)
+
+  # -- delta overlays ----------------------------------------------------
+
+  def empty_overlay(self) -> dict:
+    """All-empty insert/tombstone overlays (cached; the common
+    steady-state argument between delta refreshes)."""
+    if self._empty_overlay is None:
+      n = self._current.num_rows
+      zeros = np.zeros(0, np.int64)
+      ip, ix = _delta_csr(zeros, zeros, n, self.delta_capacity,
+                          self.layout, self.device)
+      dp, dx = _delta_csr(zeros, zeros, n, self.delta_capacity,
+                          self.layout, self.device)
+      self._empty_overlay = {
+          'ins_indptr': ip, 'ins_indices': ix,
+          'del_indptr': dp, 'del_indices': dx,
+      }
+    return self._empty_overlay
+
+  def build_overlay(self, buffer: EdgeDeltaBuffer) -> dict:
+    """Device overlays for the buffer's CURRENT pending set (a
+    non-draining view). Shapes are always [N+1]/[delta_capacity] —
+    refreshing the overlay never changes compiled signatures.
+
+    Builds are memoized on the buffer's ``mutation_seq``, so redundant
+    refreshes (feature-only staging, background-thread ticks with no
+    new ops) cost a dict lookup. An actual change still rebuilds the
+    full [N+1] indptr host-side — on very large node spaces prefer
+    StreamIngestor(auto_refresh=False) + the background cadence over
+    per-write refreshes.
+    """
+    assert buffer.capacity <= self.delta_capacity, (
+        f'buffer capacity {buffer.capacity} exceeds the overlay '
+        f'capacity {self.delta_capacity} the compiled shapes carry')
+    key = (id(buffer), buffer.mutation_seq, self._current.version)
+    if self._overlay_cache is not None \
+        and self._overlay_cache[0] == key:
+      return self._overlay_cache[1]
+    cut = buffer.view()
+    if cut.num_ops == 0:
+      self._overlay_cache = (key, self.empty_overlay())
+      return self._overlay_cache[1]
+    n = self._current.num_rows
+    ip, ix = _delta_csr(cut.ins_src, cut.ins_dst, n,
+                        self.delta_capacity, self.layout, self.device)
+    dp, dx = _delta_csr(cut.del_src, cut.del_dst, n,
+                        self.delta_capacity, self.layout, self.device)
+    self._overlay_cache = (key, {'ins_indptr': ip, 'ins_indices': ix,
+                                 'del_indptr': dp, 'del_indices': dx})
+    return self._overlay_cache[1]
+
+  # -- compaction --------------------------------------------------------
+
+  def compact(self, edge_cut: Optional[EdgeDeltaCut] = None,
+              feat_cut: Optional[FeatureDeltaCut] = None
+              ) -> Tuple[Snapshot, dict]:
+    """Merge a drained delta into a fresh snapshot and swap it in.
+
+    Returns (new_snapshot, info). ``info['touched']`` is the node-id
+    set whose cached embeddings the merge staled: row-axis endpoints of
+    inserted/deleted edges (their sampled neighborhood changed) plus
+    feature-updated ids. ``info['capacity_grown']`` flags an
+    edge-capacity growth (the one event that recompiles samplers).
+
+    Concurrent compactions are serialized (readers are never blocked);
+    each call folds its own cut on top of whatever version is current
+    when it enters.
+    """
+    with self._compact_serial:
+      return self._compact_locked(edge_cut, feat_cut)
+
+  def _compact_locked(self, edge_cut, feat_cut):
+    t0 = time.perf_counter()
+    old = self._current
+    topo = old.topo
+    layout = topo.layout
+
+    # base edge list in (src, dst) orientation + aligned ids/weights
+    ptr_axis, other, eids = topo.to_coo()
+    weights = topo.edge_weights
+    if layout == 'CSR':
+      src, dst = ptr_axis, other
+    else:
+      src, dst = other, ptr_axis
+    touched: List[np.ndarray] = []
+
+    if edge_cut is not None and edge_cut.del_src.size:
+      space = max(topo.num_rows, topo.num_cols,
+                  int(edge_cut.del_src.max(initial=0)) + 1,
+                  int(edge_cut.del_dst.max(initial=0)) + 1)
+      base_keys = _pair_key(src, dst, space)
+      del_keys = _pair_key(edge_cut.del_src, edge_cut.del_dst, space)
+      keep = ~np.isin(base_keys, del_keys)
+      src, dst, eids = src[keep], dst[keep], eids[keep]
+      if weights is not None:
+        weights = weights[keep]
+      touched.append(edge_cut.del_src if layout == 'CSR'
+                     else edge_cut.del_dst)
+    if edge_cut is not None and edge_cut.ins_src.size:
+      n_ins = edge_cut.ins_src.shape[0]
+      new_ids = np.arange(self._next_edge_id,
+                          self._next_edge_id + n_ins, dtype=np.int64)
+      self._next_edge_id += n_ins
+      src = np.concatenate([src, edge_cut.ins_src])
+      dst = np.concatenate([dst, edge_cut.ins_dst])
+      eids = np.concatenate([eids, new_ids])
+      if weights is not None:
+        # inserted edges default to unit weight (weighted streaming
+        # inserts are a follow-up; the surviving base weights persist)
+        weights = np.concatenate(
+            [weights, np.ones(n_ins, weights.dtype)])
+      touched.append(edge_cut.ins_src if layout == 'CSR'
+                     else edge_cut.ins_dst)
+
+    new_topo = Topology(
+        edge_index=np.stack([src, dst]).astype(np.int64),
+        edge_ids=eids, edge_weights=weights, layout=layout,
+        num_rows=topo.num_rows, num_cols=topo.num_cols,
+        index_dtype=topo._index_dtype)
+
+    feature = old.feature
+    if feat_cut is not None and feat_cut.ids.size:
+      assert feature is not None, \
+          'feature updates staged but the stream carries no Feature'
+      feature = feature.with_updated_rows(feat_cut.ids,
+                                          feat_cut.values)
+      touched.append(feat_cut.ids)
+
+    capacity = self.edge_capacity
+    grown = False
+    if new_topo.num_edges > capacity:
+      # round up in delta-sized steps: repeated pure-insert epochs pay
+      # one growth (and one recompile) per several compactions
+      grow = new_topo.num_edges + 4 * self.delta_capacity - capacity
+      steps = -(-grow // max(self.delta_capacity, 1))
+      capacity += steps * max(self.delta_capacity, 1)
+      grown = True
+      self.capacity_growths += 1
+
+    snap = Snapshot(old.version + 1, new_topo, feature, capacity,
+                    self.device)
+    with self._lock:
+      self.edge_capacity = capacity
+      self._current = snap
+      old._retired = True
+      self._retired.append(old)
+      self._reap_locked()
+    self.compactions += 1
+    self.last_compaction_s = time.perf_counter() - t0
+    info = {
+        'version': snap.version,
+        'num_edges': snap.num_edges,
+        'touched': (np.unique(np.concatenate(touched))
+                    if touched else np.zeros(0, np.int64)),
+        'capacity_grown': grown,
+        'edge_capacity': capacity,
+        'compaction_s': self.last_compaction_s,
+    }
+    return snap, info

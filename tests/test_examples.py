@@ -42,6 +42,17 @@ def test_serve_sage_example():
   assert 'cache_hit=' in out
 
 
+@pytest.mark.slow
+def test_stream_updates_example():
+  """Train -> serve -> live edge+feature updates -> cache-coherent
+  fresh predictions (slow: a jax subprocess cold-start; the in-process
+  stream path is covered by tests/test_stream.py in tier-1)."""
+  out = _run('stream_updates.py', '--nodes', '2000',
+             '--max-steps', '3', timeout=300)
+  assert 'steady-state recompiles across swap: 0' in out
+  assert 'fresh predictions for updated nodes:' in out
+
+
 def test_unsup_example():
   out = _run('graph_sage_unsup.py', '--epochs', '1', timeout=300)
   assert 'loss=' in out

@@ -1,0 +1,612 @@
+"""Stream subsystem: delta buffers, snapshot compaction + RCU swap,
+delta-aware sampling, cache-coherent serving, and the ingest policy.
+
+The two load-bearing guarantees (ISSUE acceptance):
+
+  * serving across snapshot swaps incurs ZERO steady-state recompiles —
+    asserted via the engine forward trace counters, the sampler's
+    compiled-program count, and StreamSampler.trace_count;
+  * deterministic full-neighborhood sampling over base-CSR + delta
+    windows is IDENTICAL to sampling the compacted CSR (insert and
+    delete cases), and cache entries for updated nodes are provably
+    never served post-update.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fixtures import ring_dataset, ring_edges
+from glt_tpu.serving import InferenceEngine, ServingMetrics
+from glt_tpu.stream import (
+    CompactionPolicy, DeltaOverflow, EdgeDeltaBuffer, FeatureDeltaBuffer,
+    SnapshotManager, StreamIngestor, StreamSampler,
+)
+
+N = 24
+
+
+def make_manager(num_nodes=N, delta_capacity=64, **kw):
+  ds = ring_dataset(num_nodes=num_nodes)
+  mgr = SnapshotManager(ds.get_graph().topo, ds.get_node_feature(),
+                        delta_capacity=delta_capacity, **kw)
+  return ds, mgr
+
+
+def canon(out):
+  """SamplerOutput -> (node-id set, (parent, child) global-id pair set):
+  order-insensitive comparison across engines/snapshot layouts."""
+  node = np.asarray(out.node)
+  row = np.asarray(out.row)
+  col = np.asarray(out.col)
+  mask = np.asarray(out.edge_mask)
+  pairs = {(int(node[col[i]]), int(node[row[i]]))
+           for i in range(mask.size) if mask[i]}
+  return set(node[:int(out.node_count)].tolist()), pairs
+
+
+# -- delta buffers -------------------------------------------------------
+
+def test_edge_delta_staging_and_cancellation():
+  buf = EdgeDeltaBuffer(capacity=16, num_nodes=N)
+  assert buf.insert_edges([1, 2], [3, 4]) == 2
+  assert buf.size == 2
+  # delete cancels the matching pending insert in place
+  buf.delete_edges([1], [3])
+  cut = buf.view()
+  assert cut.ins_src.tolist() == [2]
+  assert cut.del_src.tolist() == [1]
+  # a reinsert COEXISTS with the tombstone (tombstone clears the base
+  # instances, the insert contributes exactly one fresh one)
+  buf.insert_edges([1], [3])
+  cut = buf.view()
+  assert cut.del_src.tolist() == [1] and 1 in cut.ins_src.tolist()
+  assert buf.stats()['total_inserts'] == 3
+
+
+def test_insert_after_delete_of_nonexistent_edge_survives():
+  """Regression: delete of an edge the base never held, then insert of
+  the same pair — the insert must survive to the overlay AND the
+  compacted CSR (the old staging-time cancellation silently lost it)."""
+  ds, mgr = make_manager()
+  samp = StreamSampler(mgr, [-1], delta_window=4, seed=0)
+  ing = StreamIngestor(mgr, sampler=samp, policy=CompactionPolicy(
+      occupancy_threshold=2.0, max_staleness_s=1e9))
+  ing.delete_edges([5], [17])   # (5, 17) is not a ring edge
+  ing.insert_edges([5], [17])
+  _, pairs = canon(samp.sample_from_nodes(np.array([5]), n_valid=1))
+  assert (5, 17) in pairs       # visible pre-compaction
+  ing.flush()
+  t = mgr.current().topo
+  seg = np.asarray(t.indices[t.indptr[5]:t.indptr[6]])
+  assert (seg == 17).sum() == 1  # exactly one instance post-compaction
+
+
+def test_delete_then_reinsert_of_base_edge_yields_one_instance():
+  ds, mgr = make_manager()
+  buf = EdgeDeltaBuffer(capacity=16, num_nodes=N)
+  buf.delete_edges([3], [4])    # base ring edge
+  buf.insert_edges([3], [4])
+  snap, _ = mgr.compact(buf.drain())
+  t = snap.topo
+  seg = np.asarray(t.indices[t.indptr[3]:t.indptr[4]])
+  assert (seg == 4).sum() == 1
+
+
+def test_restage_respects_tombstones_staged_during_compaction():
+  """Regression: an insert drained into a failed compaction must NOT
+  resurrect past a delete that arrived while the cut was out."""
+  buf = EdgeDeltaBuffer(capacity=16, num_nodes=N)
+  buf.insert_edges([1], [2])
+  cut = buf.drain()
+  buf.delete_edges([1], [2])    # ordered AFTER the cut's insert
+  buf.restage(cut)
+  v = buf.view()
+  assert 1 not in v.ins_src.tolist()      # insert cancelled
+  assert v.del_src.tolist() == [1]        # tombstone preserved
+
+
+def test_bipartite_bounds_checked_per_axis():
+  """Regression: a row-axis-out-of-range endpoint on a non-square
+  topology must be rejected at staging, not crash compaction later."""
+  from glt_tpu.data import Topology
+  # 5 src rows, 20 dst cols
+  ei = np.stack([np.arange(5), np.arange(5) + 10])
+  topo = Topology(edge_index=ei, layout='CSR', num_rows=5, num_cols=20)
+  mgr = SnapshotManager(topo, delta_capacity=8)
+  ing = StreamIngestor(mgr, policy=CompactionPolicy(
+      occupancy_threshold=2.0, max_staleness_s=1e9))
+  with pytest.raises(ValueError, match='src endpoint out of range'):
+    ing.insert_edges([10], [3])           # 10 >= num_rows=5
+  ing.insert_edges([3], [15])             # valid bipartite edge
+  info = ing.flush()
+  assert info['num_edges'] == 6
+
+
+def test_overlay_build_memoized_on_mutation_seq():
+  ds, mgr = make_manager()
+  buf = EdgeDeltaBuffer(capacity=16, num_nodes=N)
+  buf.insert_edges([1], [5])
+  o1 = mgr.build_overlay(buf)
+  assert mgr.build_overlay(buf) is o1     # unchanged set: cached
+  buf.insert_edges([2], [6])
+  o2 = mgr.build_overlay(buf)
+  assert o2 is not o1                     # mutation invalidates
+
+
+def test_edge_delta_overflow_and_watermark():
+  buf = EdgeDeltaBuffer(capacity=4, num_nodes=N)
+  buf.insert_edges([0, 1, 2], [1, 2, 3])
+  assert buf.occupancy == pytest.approx(0.75)
+  assert buf.high_watermark == pytest.approx(0.75)
+  with pytest.raises(DeltaOverflow):
+    buf.insert_edges([4, 5], [6, 7])
+  assert buf.size == 3  # rejected batch staged nothing
+  with pytest.raises(ValueError, match='out of range'):
+    buf.insert_edges([0], [N + 5])
+
+
+def test_edge_delta_drain_and_restage():
+  buf = EdgeDeltaBuffer(capacity=16, num_nodes=N)
+  buf.insert_edges([1], [2])
+  buf.delete_edges([3], [4])
+  cut = buf.drain()
+  assert buf.size == 0 and cut.num_ops == 2
+  buf.insert_edges([5], [6])
+  buf.restage(cut)  # failed compaction path: nothing lost
+  v = buf.view()
+  assert sorted(v.ins_src.tolist()) == [1, 5]
+  assert v.del_src.tolist() == [3]
+
+
+def test_feature_delta_last_write_wins():
+  buf = FeatureDeltaBuffer(capacity=8, num_nodes=N)
+  buf.update_rows([3], np.ones((1, 4), np.float32))
+  buf.update_rows([3], np.full((1, 4), 2.0, np.float32))
+  assert buf.size == 1
+  cut = buf.drain()
+  np.testing.assert_array_equal(cut.values[0], [2, 2, 2, 2])
+  # staged rows own their memory
+  src = np.zeros((1, 4), np.float32)
+  buf.update_rows([5], src)
+  src[:] = 9
+  np.testing.assert_array_equal(buf.drain().values[0], [0, 0, 0, 0])
+
+
+# -- compaction parity (acceptance) --------------------------------------
+
+@pytest.mark.parametrize('case', ['insert', 'delete', 'mixed'])
+def test_full_neighbor_delta_vs_compacted_parity(case):
+  """Deterministic full-neighborhood sampling: base CSR + delta window
+  == compacted CSR, for the same seeds."""
+  ds, mgr = make_manager()
+  samp = StreamSampler(mgr, [-1, -1], delta_window=4, seed=0)
+  buf = EdgeDeltaBuffer(capacity=64, num_nodes=N)
+  if case in ('insert', 'mixed'):
+    buf.insert_edges([0, 0, 5], [7, 9, 17])
+  if case in ('delete', 'mixed'):
+    buf.delete_edges([0, 6], [1, 7])
+  samp.refresh_overlay(buf)
+  seeds = np.array([0, 5, 6, 11])
+  live = canon(samp.sample_from_nodes(seeds, n_valid=4))
+
+  snap, info = mgr.compact(buf.drain())
+  samp.refresh_overlay(buf)  # residual = empty
+  compacted = canon(samp.sample_from_nodes(seeds, n_valid=4))
+  assert live == compacted
+
+  # cross-check against a cold-built NeighborSampler on the new topo
+  from glt_tpu.data import Graph
+  from glt_tpu.sampler import NeighborSampler
+  ref = NeighborSampler(Graph(snap.topo), [-1, -1], edge_dir='out',
+                        full_neighbor_cap=-samp._base_fanouts[0])
+  assert canon(ref.sample_from_nodes(seeds, n_valid=4)) == compacted
+
+
+def test_uniform_hop_respects_tombstones_and_sees_inserts():
+  ds, mgr = make_manager()
+  samp = StreamSampler(mgr, [2], delta_window=4, seed=0)
+  buf = EdgeDeltaBuffer(capacity=64, num_nodes=N)
+  buf.delete_edges([0], [1])      # 0 keeps only 0->2 in the base
+  buf.insert_edges([0, 0], [9, 11])
+  samp.refresh_overlay(buf)
+  for trial in range(5):
+    _, pairs = canon(samp.sample_from_nodes(np.array([0]), n_valid=1))
+    children = {c for p, c in pairs if p == 0}
+    assert (0, 1) not in pairs            # tombstone never sampled
+    assert {9, 11} <= children            # insert window is full
+    assert children <= {2, 9, 11}
+
+
+def test_multigraph_delete_removes_all_instances():
+  ds, mgr = make_manager()
+  buf = EdgeDeltaBuffer(capacity=64, num_nodes=N)
+  buf.insert_edges([3], [4])  # duplicates the existing base edge 3->4
+  snap, _ = mgr.compact(buf.drain())
+  dup = snap.topo
+  seg = dup.indices[dup.indptr[3]:dup.indptr[4]]
+  assert (np.asarray(seg) == 4).sum() == 2
+  buf.delete_edges([3], [4])
+  snap2, _ = mgr.compact(buf.drain())
+  seg = snap2.topo.indices[snap2.topo.indptr[3]:snap2.topo.indptr[4]]
+  assert (np.asarray(seg) == 4).sum() == 0
+
+
+def test_compaction_preserves_edge_ids_and_sort_invariant():
+  ds, mgr = make_manager()
+  base = mgr.current().topo
+  buf = EdgeDeltaBuffer(capacity=64, num_nodes=N)
+  buf.insert_edges([2, 8], [10, 1])
+  buf.delete_edges([5], [6])
+  snap, info = mgr.compact(buf.drain())
+  t = snap.topo
+  # columns stay ascending within each row (the locality invariant the
+  # samplers rely on)
+  for v in range(t.num_rows):
+    seg = np.asarray(t.indices[t.indptr[v]:t.indptr[v + 1]])
+    assert np.all(np.diff(seg) >= 0)
+  # surviving base edges keep their original ids; new edges get fresh
+  # ids past the old id space
+  src, dst, eids = t.to_coo()
+  old_src, old_dst, old_eids = base.to_coo()
+  old_map = {(int(s), int(d)): int(e)
+             for s, d, e in zip(old_src, old_dst, old_eids)}
+  fresh = []
+  for s, d, e in zip(src, dst, eids):
+    key = (int(s), int(d))
+    if key in old_map:
+      assert int(e) == old_map[key]
+    else:
+      fresh.append(int(e))
+  assert sorted(fresh) == [2 * N, 2 * N + 1]
+  assert info['num_edges'] == 2 * N + 1  # +2 inserts, -1 delete
+
+
+# -- snapshots: RCU + zero recompiles ------------------------------------
+
+def test_rcu_inflight_reader_defers_free():
+  ds, mgr = make_manager()
+  old = mgr.acquire()
+  snap, _ = mgr.compact()
+  assert mgr.current() is snap and old is not snap
+  assert mgr.num_retired == 1 and not old.freed
+  # the in-flight reader still sees intact device arrays
+  assert np.asarray(old.arrays['indptr']).shape[0] == N + 1
+  mgr.release(old)
+  assert mgr.num_retired == 0 and old.freed and old.arrays == {}
+
+
+def test_sampler_zero_recompiles_across_swaps():
+  ds, mgr = make_manager()
+  samp = StreamSampler(mgr, [2, 2], delta_window=2, seed=0)
+  buf = EdgeDeltaBuffer(capacity=64, num_nodes=N)
+  seeds = np.arange(4)
+  samp.sample_from_nodes(seeds, n_valid=4)
+  traces, fns = samp.trace_count, samp.num_compiled_fns
+  for round_ in range(3):
+    buf.insert_edges([round_], [round_ + 10])
+    samp.refresh_overlay(buf)
+    samp.sample_from_nodes(seeds, n_valid=4)
+    mgr.compact(buf.drain())
+    samp.refresh_overlay(buf)
+    samp.sample_from_nodes(seeds, n_valid=4)
+  assert samp.trace_count == traces       # no retrace, ever
+  assert samp.num_compiled_fns == fns
+  assert mgr.current().version == 3
+
+
+def test_capacity_growth_is_detected_and_counted():
+  ds, mgr = make_manager(delta_capacity=8, edge_capacity=2 * N + 4)
+  samp = StreamSampler(mgr, [2], delta_window=2, seed=0)
+  samp.sample_from_nodes(np.arange(2), n_valid=2)
+  t0 = samp.trace_count
+  buf = EdgeDeltaBuffer(capacity=8, num_nodes=N)
+  buf.insert_edges(np.arange(6), np.full(6, 20))
+  snap, info = mgr.compact(buf.drain())
+  assert info['capacity_grown'] and mgr.capacity_growths == 1
+  samp.sample_from_nodes(np.arange(2), n_valid=2)
+  # growth IS the one recompile event, and it is visible
+  assert samp.trace_count == t0 + 1
+
+
+# -- serving integration (acceptance) ------------------------------------
+
+OUT_DIM = 3
+
+
+@pytest.fixture(scope='module')
+def stream_serving():
+  import jax
+
+  from glt_tpu.models import GraphSAGE
+  ds, mgr = make_manager()
+  sampler = StreamSampler(mgr, [-1, -1], delta_window=4, seed=0)
+  model = GraphSAGE(hidden_features=8, out_features=OUT_DIM,
+                    num_layers=2)
+  eng = InferenceEngine(ds, model, None, [-1, -1], buckets=(4,),
+                        sampler=sampler)
+  eng.init_params(jax.random.key(0))
+  eng.warmup()
+  ing = StreamIngestor(
+      mgr, sampler=sampler, engine=eng,
+      policy=CompactionPolicy(occupancy_threshold=2.0,
+                              max_staleness_s=0.0))
+  return ds, mgr, sampler, eng, ing
+
+
+def test_serving_zero_recompiles_across_snapshot_swap(stream_serving):
+  ds, mgr, sampler, eng, ing = stream_serving
+  eng.infer([1, 2, 3])
+  warm = eng.compile_stats()
+  traces = sampler.trace_count
+  ing.insert_edges([1], [9])
+  eng.infer([1, 2, 3])              # delta visible pre-compaction
+  assert ing.flush() is not None    # >= 1 snapshot swap
+  eng.infer([1, 2, 3, 7])
+  now = eng.compile_stats()
+  assert now['forward_traces'] == warm['forward_traces']
+  assert now['sampler_compiled_fns'] == warm['sampler_compiled_fns']
+  assert sampler.trace_count == traces
+  assert mgr.current().version >= 1
+
+
+def test_updated_nodes_never_served_stale(stream_serving):
+  """THE cache-coherence guarantee: after update_snapshot, entries for
+  touched nodes are gone (stale lookups miss) and fresh inference
+  reflects the new features."""
+  ds, mgr, sampler, eng, ing = stream_serving
+  before = eng.infer([5, 6, 13])
+  assert 5 in eng.cache.lookup([5], eng.model_version)
+  new_row = np.full((1, ds.get_node_feature().feature_dim), 123.0,
+                    np.float32)
+  ing.update_features([5], new_row)
+  info = ing.flush()
+  assert 5 in info['touched'].tolist()
+  # stale entry provably gone: the lookup misses across ALL versions
+  assert eng.cache.lookup([5], eng.model_version) == {}
+  after = eng.infer([5, 6, 13])
+  assert not np.allclose(before[0], after[0])   # fresh features used
+  # node 13's 2-hop neighborhood {13..17} excludes 5: cache-served
+  np.testing.assert_allclose(before[2], after[2])
+
+
+def test_invalidation_expands_to_in_neighbors(stream_serving):
+  ds, mgr, sampler, eng, ing = stream_serving
+  eng.infer([9, 10, 11])            # 9,10,11 cached; 11 samples 12,13
+  snap = mgr.current()
+  # feature of 11 changes: nodes 9,10 (in-neighbors via CSC) aggregate
+  # it, node 4 does not
+  expanded = snap.expand_affected(np.array([11]))
+  assert {9, 10, 11} <= set(expanded.tolist())
+  eng.infer([4])
+  dropped = eng.update_snapshot(snap, touched_ids=[11],
+                                expand_in_neighbors=True)
+  assert dropped >= 3
+  v = eng.model_version
+  assert eng.cache.lookup([9], v) == {}
+  assert eng.cache.lookup([10], v) == {}
+  assert 4 in eng.cache.lookup([4], v)
+
+
+def test_ingest_gauges_surface_in_serving_metrics(stream_serving):
+  ds, mgr, sampler, eng, ing = stream_serving
+  metrics = ServingMetrics()
+  ing.metrics = metrics
+  ing.insert_edges([2], [15])
+  ing.flush()
+  g = metrics.snapshot()['gauges']
+  assert g['snapshot_version'] == mgr.current().version
+  assert g['compactions'] == mgr.compactions
+  assert g['delta_occupancy'] == 0.0
+  assert g['last_compaction_ms'] > 0
+
+
+# -- ingest policy -------------------------------------------------------
+
+def test_occupancy_policy_triggers_compaction():
+  ds, mgr = make_manager(delta_capacity=16)
+  ing = StreamIngestor(mgr, policy=CompactionPolicy(
+      occupancy_threshold=0.5, max_staleness_s=1e9))
+  ing.insert_edges([0], [5])
+  assert mgr.current().version == 0      # below watermark: staged only
+  ing.insert_edges(np.arange(7), np.full(7, 11))
+  assert mgr.current().version == 1      # 8/16 >= 0.5 -> compacted
+  assert ing.edges.size == 0
+
+
+def test_staleness_policy_and_background_thread():
+  ds, mgr = make_manager()
+  ing = StreamIngestor(mgr, policy=CompactionPolicy(
+      occupancy_threshold=2.0, max_staleness_s=0.05))
+  ing.update_features([3], np.ones((1, 16), np.float32))
+  assert mgr.current().version == 0
+  ing.start(poll_interval_s=0.02)
+  try:
+    deadline = time.monotonic() + 5
+    while mgr.current().version == 0 and time.monotonic() < deadline:
+      time.sleep(0.02)
+    assert mgr.current().version == 1
+  finally:
+    ing.stop()
+
+
+def test_concurrent_writers_consistent_totals():
+  ds, mgr = make_manager(delta_capacity=4096)
+  ing = StreamIngestor(mgr, policy=CompactionPolicy(
+      occupancy_threshold=0.25, max_staleness_s=1e9))
+  errors = []
+
+  def writer(rank):
+    rng = np.random.default_rng(rank)
+    try:
+      for _ in range(50):
+        s, d = rng.integers(0, N, 2)
+        ing.insert_edges([int(s)], [int(d)])
+    except Exception as e:  # pragma: no cover
+      errors.append(e)
+
+  threads = [threading.Thread(target=writer, args=(r,))
+             for r in range(4)]
+  for t in threads:
+    t.start()
+  for t in threads:
+    t.join()
+  assert not errors
+  ing.flush()
+  assert ing.edges.total_inserts == 200
+  assert mgr.current().topo.num_edges == 2 * N + 200
+  assert mgr.compactions >= 1
+
+
+# -- distributed apply-delta RPC -----------------------------------------
+
+def test_dist_server_apply_delta_roundtrip():
+  from glt_tpu.channel import pack_message
+  from glt_tpu.distributed.dist_server import DistServer
+  ds = ring_dataset(num_nodes=12)
+  srv = DistServer(ds)
+  before = srv.get_edge_size()
+  out = srv.apply_delta(pack_message({
+      'ins': np.array([[0, 1], [6, 7]], np.int64)}))
+  assert out['applied']['inserts'] == 2 and not out['compacted']
+  assert out['pending'] == 2
+  out = srv.apply_delta(pack_message({
+      'dels': np.array([[0], [1]], np.int64),
+      'feat_ids': np.array([2], np.int64),
+      'feat_rows': np.full((1, 16), 42.0, np.float32),
+      'compact': np.ones(1, np.int8)}))
+  assert out['compacted'] and out['version'] == 1
+  assert srv.get_edge_size() == before + 2 - 1
+  # the data plane serves the fresh snapshot immediately
+  from glt_tpu.channel import unpack_message
+  feats = unpack_message(srv.get_node_feature(
+      pack_message({'ids': np.array([2], np.int64)})))['feats']
+  np.testing.assert_allclose(feats[0], 42.0)
+
+
+def test_feature_staging_rejects_bad_rows_and_featureless_streams():
+  """Wrong-width rows and updates on topology-only streams must fail
+  at the STAGING call — deferred to compaction they would restage
+  forever and wedge the stream."""
+  ds, mgr = make_manager()
+  ing = StreamIngestor(mgr, policy=CompactionPolicy(
+      occupancy_threshold=2.0, max_staleness_s=1e9))
+  with pytest.raises(ValueError, match='row width'):
+    ing.update_features([1, 2], np.ones((2, 7), np.float32))  # D=16
+  mgr2 = SnapshotManager(ds.get_graph().topo, None, delta_capacity=8)
+  ing2 = StreamIngestor(mgr2, policy=CompactionPolicy(
+      occupancy_threshold=2.0, max_staleness_s=1e9))
+  with pytest.raises(ValueError, match='no Feature'):
+    ing2.update_features([1], np.ones((1, 16), np.float32))
+  ing2.insert_edges([1], [2])
+  assert ing2.flush()['version'] == 1     # topology-only still works
+
+
+def test_rejected_delete_leaves_pending_set_untouched():
+  """Regression: a delete rejected with DeltaOverflow must not have
+  already cancelled matching pending inserts (op not applied == no
+  side effects), and the overlay memo must stay valid."""
+  ds, mgr = make_manager()
+  buf = EdgeDeltaBuffer(capacity=4, num_nodes=N)
+  buf.insert_edges([1, 2, 3], [2, 3, 4])
+  o1 = mgr.build_overlay(buf)
+  with pytest.raises(DeltaOverflow):
+    buf.delete_edges([1, 5, 6, 7], [2, 6, 7, 8])  # would overflow
+  v = buf.view()
+  assert sorted(v.ins_src.tolist()) == [1, 2, 3]  # (1,2) NOT cancelled
+  assert v.del_src.size == 0
+  assert mgr.build_overlay(buf) is o1             # memo still valid
+
+
+def test_partitioned_feature_updates_validated_in_global_id_space():
+  """Regression: a Feature with an id2index map (partitioned store)
+  takes GLOBAL ids; staging must accept owned global ids >= the local
+  row count and reject unowned ids that map to no local row."""
+  from glt_tpu.data import Feature, Topology
+  n_global, n_local = 40, 12
+  owned = np.arange(0, n_global, 3)[:n_local]     # global ids owned
+  id2index = np.full(n_global, -1, np.int64)
+  id2index[owned] = np.arange(n_local)
+  feat = Feature(np.zeros((n_local, 4), np.float32), id2index=id2index)
+  ei = np.stack([np.arange(8), (np.arange(8) + 1) % 8])
+  mgr = SnapshotManager(Topology(edge_index=ei, num_nodes=n_global),
+                        feat, delta_capacity=8)
+  ing = StreamIngestor(mgr, policy=CompactionPolicy(
+      occupancy_threshold=2.0, max_staleness_s=1e9))
+  big_owned = int(owned[-1])
+  assert big_owned >= n_local                     # the interesting case
+  ing.update_features([big_owned], np.ones((1, 4), np.float32))
+  with pytest.raises(ValueError, match='not owned'):
+    ing.update_features([1], np.ones((1, 4), np.float32))  # unowned
+  info = ing.flush()
+  assert big_owned in info['touched'].tolist()
+  got = mgr.current().feature[np.array([big_owned])]
+  np.testing.assert_allclose(got[0], 1.0)
+
+
+def test_flush_restages_edges_when_feature_drain_fails():
+  ds, mgr = make_manager()
+  ing = StreamIngestor(mgr, policy=CompactionPolicy(
+      occupancy_threshold=2.0, max_staleness_s=1e9))
+  ing.insert_edges([1], [9])
+
+  def boom():
+    raise RuntimeError('kaput')
+  ing.features.drain = boom
+  with pytest.raises(RuntimeError, match='kaput'):
+    ing.flush()
+  assert ing.edges.size == 1              # drained edges restaged
+  assert mgr.current().version == 0
+
+
+def test_dist_server_rebinds_on_auto_compaction():
+  """Regression: a compaction auto-triggered by the policy DURING
+  staging (not by this call's explicit compact flag) must still rebind
+  the served dataset and be reported."""
+  from glt_tpu.channel import pack_message
+  from glt_tpu.distributed.dist_server import DistServer
+  ds = ring_dataset(num_nodes=12)
+  srv = DistServer(ds)
+  stream = srv._stream_ingestor()
+  stream.policy = CompactionPolicy(occupancy_threshold=1e-9,
+                                   max_staleness_s=1e9)
+  before = srv.get_edge_size()
+  out = srv.apply_delta(pack_message({
+      'ins': np.array([[0], [6]], np.int64)}))   # no 'compact' flag
+  assert out['compacted'] and out['version'] >= 1
+  assert srv.get_edge_size() == before + 1       # dataset rebound
+
+
+def test_dist_server_stream_init_is_single():
+  from glt_tpu.distributed.dist_server import DistServer
+  srv = DistServer(ring_dataset(num_nodes=12))
+  got = []
+  threads = [threading.Thread(
+      target=lambda: got.append(srv._stream_ingestor()))
+      for _ in range(4)]
+  for t in threads:
+    t.start()
+  for t in threads:
+    t.join()
+  assert all(g is got[0] for g in got)    # one chain, no racing init
+
+
+def test_dist_apply_delta_over_rpc():
+  from glt_tpu.distributed import rpc as rpc_mod
+  from glt_tpu.distributed.dist_server import DistServer
+  ds = ring_dataset(num_nodes=12)
+  srv = DistServer(ds)
+  server = rpc_mod.RpcServer(host='127.0.0.1', port=0, auto_start=False)
+  server.register('apply_delta', srv.apply_delta)
+  server.start()
+  try:
+    from glt_tpu.channel import pack_message
+    cli = rpc_mod.RpcClient(server.host, server.port, timeout=30)
+    out = cli.request('apply_delta', pack_message({
+        'ins': np.array([[3], [9]], np.int64),
+        'compact': np.ones(1, np.int8)}))
+    assert out['compacted'] and out['version'] == 1
+    cli.close()
+  finally:
+    server.stop()

@@ -88,3 +88,108 @@ def test_isolated_node_padding():
   topo = Topology(edge_index=ei, num_nodes=5)
   assert topo.indptr.shape[0] == 6
   np.testing.assert_array_equal(topo.degrees, [1, 0, 0, 0, 0])
+
+
+# -- property tests: the compaction foundation ---------------------------
+# The stream subsystem's compactor rebuilds CSRs through to_coo() /
+# flip_layout() / the constructor's _sort_within_rows; these randomized
+# invariants are what make that merge safe on real (duplicate- and
+# self-edge-bearing) graphs.
+
+def _random_multigraph(rng, n, e, self_loop_frac=0.1, dup_frac=0.3):
+  """COO with intentional self loops and exact duplicate edges."""
+  src = rng.integers(0, n, size=e)
+  dst = rng.integers(0, n, size=e)
+  loops = rng.random(e) < self_loop_frac
+  dst[loops] = src[loops]
+  n_dup = int(e * dup_frac)
+  if n_dup:
+    pick = rng.integers(0, e, size=n_dup)
+    src = np.concatenate([src, src[pick]])
+    dst = np.concatenate([dst, dst[pick]])
+  return np.stack([src, dst])
+
+
+def _triples(topo):
+  """Canonical (src, dst, eid) multiset regardless of layout."""
+  ptr, other, eids = topo.to_coo()
+  if topo.layout == 'CSR':
+    src, dst = ptr, other
+  else:
+    src, dst = other, ptr
+  return sorted(zip(src.tolist(), dst.tolist(), eids.tolist()))
+
+
+@pytest.mark.parametrize('trial', range(5))
+def test_property_to_coo_roundtrip_multigraph(trial):
+  """to_coo -> constructor reproduces the identical compressed form,
+  and the (src, dst, eid) multiset is preserved exactly — duplicate
+  and self edges included."""
+  rng = np.random.default_rng(100 + trial)
+  n = int(rng.integers(3, 60))
+  e = int(rng.integers(1, 6 * n))
+  ei = _random_multigraph(rng, n, e)
+  layout = 'CSR' if trial % 2 == 0 else 'CSC'
+  topo = Topology(edge_index=ei, layout=layout, num_nodes=n)
+  ptr, other, eids = topo.to_coo()
+  rebuilt = Topology(
+      edge_index=np.stack([ptr, other] if layout == 'CSR'
+                          else [other, ptr]),
+      edge_ids=eids, layout=layout, num_nodes=n)
+  np.testing.assert_array_equal(rebuilt.indptr, topo.indptr)
+  np.testing.assert_array_equal(rebuilt.indices, topo.indices)
+  np.testing.assert_array_equal(rebuilt.edge_ids, topo.edge_ids)
+  # the original COO multiset survives (eids map back to input slots)
+  orig = sorted(zip(ei[0].tolist(), ei[1].tolist(),
+                    range(ei.shape[1])))
+  assert _triples(topo) == orig
+
+
+@pytest.mark.parametrize('trial', range(5))
+def test_property_flip_layout_involution_multigraph(trial):
+  """flip twice == identity, and one flip preserves the edge multiset,
+  on graphs with duplicates and self loops."""
+  rng = np.random.default_rng(200 + trial)
+  n = int(rng.integers(3, 50))
+  ei = _random_multigraph(rng, n, int(rng.integers(1, 5 * n)))
+  csr = Topology(edge_index=ei, layout='CSR', num_nodes=n)
+  csc = csr.flip_layout()
+  assert csc.layout == 'CSC'
+  assert _triples(csc) == _triples(csr)
+  back = csc.flip_layout()
+  np.testing.assert_array_equal(back.indptr, csr.indptr)
+  np.testing.assert_array_equal(back.indices, csr.indices)
+  np.testing.assert_array_equal(back.edge_ids, csr.edge_ids)
+  if csr.edge_weights is not None:
+    np.testing.assert_array_equal(back.edge_weights, csr.edge_weights)
+
+
+@pytest.mark.parametrize('trial', range(5))
+def test_property_sort_within_rows_stable_on_duplicates(trial):
+  """_sort_within_rows: ascending columns per row, slot permutation is
+  a bijection, and equal columns keep their input order (lexsort is
+  stable) — the invariant that keeps duplicate edges' ids/weights
+  aligned through compaction."""
+  from glt_tpu.data.topology import _sort_within_rows
+  rng = np.random.default_rng(300 + trial)
+  n = int(rng.integers(2, 30))
+  deg = rng.integers(0, 8, size=n)
+  indptr = np.zeros(n + 1, np.int64)
+  np.cumsum(deg, out=indptr[1:])
+  e = int(indptr[-1])
+  # few distinct columns -> many duplicates within a row
+  indices = rng.integers(0, max(n // 2, 1), size=e)
+  out_ptr, out_idx, perm = _sort_within_rows(indptr, indices.copy())
+  np.testing.assert_array_equal(out_ptr, indptr)
+  assert sorted(perm.tolist()) == list(range(e))  # bijection
+  np.testing.assert_array_equal(out_idx, indices[perm])
+  for v in range(n):
+    lo, hi = indptr[v], indptr[v + 1]
+    seg = out_idx[lo:hi]
+    assert np.all(np.diff(seg) >= 0)
+    seg_perm = perm[lo:hi]
+    assert np.all((seg_perm >= lo) & (seg_perm < hi))  # row-local
+    # stability: among equal column values, original slot order holds
+    for c in np.unique(seg):
+      slots = seg_perm[seg == c]
+      assert np.all(np.diff(slots) > 0)
