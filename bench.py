@@ -95,21 +95,48 @@ def run_worker():
   indptr = jnp.asarray(topo.indptr.astype(np.int32))
   indices = jnp.asarray(topo.indices)
 
-  if os.environ.get('GLT_WINDOW_HOP', '0') in ('1', 'true'):
-    # window read path: per-row contiguous DMA + exact hub fix-up; the
-    # hub capacity comes from the graph's true hub count (host, once)
-    # so results stay bit-identical to the element path (ops/sample.py)
+  win_state = {}
+
+  def resolved_hop_engine():
+    """The hop engine the current env ACTUALLY selects (post-fallback:
+    GLT_HOP_ENGINE=pallas without an importable pallas resolves to
+    'window') — both the hop closure and the engines{} labels read
+    this, so the recorded label never claims an engine that didn't
+    run. Legacy GLT_WINDOW_HOP=1 maps to 'window'."""
+    from glt_tpu.ops.pipeline import hop_engine
+    if 'GLT_HOP_ENGINE' in os.environ:
+      return hop_engine()
+    if os.environ.get('GLT_WINDOW_HOP', '0') in ('1', 'true'):
+      return 'window'
+    return 'element'
+
+  def make_one_hop():
+    """Build the hop closure under the CURRENT env. The W-padded
+    indices copy and the true hub count are built once and shared
+    across engine passes."""
+    eng = resolved_hop_engine()
+    if eng == 'element':
+      return lambda ids, fanout, key, mask: sample_neighbors(
+          indptr, indices, ids, fanout, key, seed_mask=mask)
     win_w = int(os.environ.get('GLT_WINDOW_W', '96'))
-    n_hub = int((np.diff(topo.indptr) > win_w).sum())
-    indices_win = jnp.concatenate(
-        [indices, jnp.full((win_w,), -1, indices.dtype)])
-    print(f'# window hop: W={win_w} n_hub={n_hub}', file=sys.stderr)
-    one_hop = lambda ids, fanout, key, mask: sample_neighbors(
+    if win_state.get('w') != win_w:
+      # hub capacity from the graph's true hub count (host, once) so
+      # results stay bit-identical to the element path (ops/sample.py)
+      win_state['w'] = win_w
+      win_state['n_hub'] = int((np.diff(topo.indptr) > win_w).sum())
+      win_state['iw'] = jnp.concatenate(
+          [indices, jnp.full((win_w,), -1, indices.dtype)])
+    n_hub, iw = win_state['n_hub'], win_state['iw']
+    print(f'# hop engine: {eng} W={win_w} n_hub={n_hub}',
+          file=sys.stderr)
+    interp = False
+    if eng == 'pallas':
+      from glt_tpu.ops.pallas_kernels import interpret_default
+      interp = interpret_default()
+    return lambda ids, fanout, key, mask: sample_neighbors(
         indptr, indices, ids, fanout, key, seed_mask=mask,
-        window=(win_w, n_hub), indices_win=indices_win)
-  else:
-    one_hop = lambda ids, fanout, key, mask: sample_neighbors(
-        indptr, indices, ids, fanout, key, seed_mask=mask)
+        window=(win_w, min(n_hub, ids.shape[0])), indices_win=iw,
+        engine=eng, interpret=interp)
 
   import functools
   scan = max(int(os.environ.get('GLT_BENCH_SCAN', '4')), 1)
@@ -121,9 +148,17 @@ def run_worker():
 
   def measure():
     """Build + time the pipeline under the CURRENT env (GLT_DEDUP /
-    GLT_FUSED_HOP are read at trace time, so each call re-jits)."""
+    GLT_FUSED_HOP / GLT_HOP_ENGINE are read at trace time, so each
+    call re-jits). Returns per-engine stats: steady-state edges/s,
+    compile/trace wall-time of the first dispatch, and the number of
+    re-traces observed during the timed loop (must be 0 — any recompile
+    in steady state is a shape-stability bug)."""
+    one_hop = make_one_hop()
+    traces = {'n': 0}
+
     @functools.partial(jax.jit, donate_argnums=(2, 3))
     def sample_batch(seeds, key, table, scratch):
+      traces['n'] += 1  # trace-time side effect; executions never bump
       if scan > 1:
         from glt_tpu.ops.pipeline import multihop_sample_many
         outs, table, scratch = multihop_sample_many(
@@ -141,11 +176,16 @@ def run_worker():
     # GLT_PRNG=rbg swaps threefry for the XLA RngBitGenerator-backed
     # implementation (same knob the samplers honor, utils/rng.py)
     keys = jax.random.split(make_key(0), ITERS + WARMUP)
-    edges = None
-    for i in range(WARMUP):
+    t_c0 = time.time()
+    edges, sig, table, scratch = sample_batch(
+        jnp.asarray(seed_pool[0], jnp.int32), keys[0], table, scratch)
+    jax.block_until_ready((edges, sig))
+    compile_s = time.time() - t_c0   # trace + compile + first run
+    for i in range(1, WARMUP):
       edges, sig, table, scratch = sample_batch(
           jnp.asarray(seed_pool[i], jnp.int32), keys[i], table, scratch)
     jax.block_until_ready((edges, sig))
+    traces_warm = traces['n']
     edge_counts, sigs = [], []
     t0 = time.time()
     for i in range(WARMUP, WARMUP + ITERS):
@@ -155,36 +195,78 @@ def run_worker():
       sigs.append(sig)
     jax.block_until_ready((edge_counts[-1], sigs[-1]))
     dt = time.time() - t0
-    return int(np.sum([int(e) for e in edge_counts])) / dt
+    return {
+        'edges_per_sec': int(np.sum([int(e) for e in edge_counts])) / dt,
+        'compile_s': compile_s,
+        'steady_recompiles': traces['n'] - traces_warm,
+    }
 
-  # Engine self-selection: on the sort engine (the TPU default) also
-  # try GLT_FUSED_HOP when neither knob was forced and the budget hint
-  # leaves room — the headline then reports the best measured variant
-  # (both appear in `engines`). The fused A/B has never run on real
-  # hardware (tunnel wedged since r2), so the driver's end-of-round
-  # bench doubles as the deciding experiment.
+  # Engine self-selection: race the dedup variants (sort vs sort+fused)
+  # and the hop-read engines when the knobs were not forced and the
+  # budget hint leaves room — the headline then reports the best
+  # measured variant, and `engines{}` records every contender's
+  # edges/s + compile wall-time + steady-state recompile count. The
+  # pallas megakernel has never run on real hardware (tunnel wedged
+  # since r2), so the driver's end-of-round bench doubles as the
+  # deciding experiment; it only races where it can actually compile
+  # (TPU backend, pallas importable) unless GLT_HOP_ENGINE forces it.
   from glt_tpu.ops.pipeline import dedup_engine, fused_hops
   t_start = time.time()
   worker_budget = float(os.environ.get('GLT_BENCH_WORKER_BUDGET', '0'))
   engines = {}
-  base_label = dedup_engine() + ('+fused' if fused_hops() else '')
-  eps = engines[base_label] = measure()
+
+  def hop_suffix():
+    eng = resolved_hop_engine()
+    return '' if eng == 'element' else '+' + eng
+
+  base_label = (dedup_engine() + ('+fused' if fused_hops() else '')
+                + hop_suffix())
+  res = engines[base_label] = measure()
+  eps = res['edges_per_sec']
   first_cost = time.time() - t_start
-  try_fused = (dedup_engine() == 'sort' and not fused_hops()
-               and 'GLT_FUSED_HOP' not in os.environ
-               and (not worker_budget
-                    or time.time() - t_start + first_cost * 1.5 + 30
-                    < worker_budget))
-  if try_fused:
-    os.environ['GLT_FUSED_HOP'] = '1'
+
+  def room_for_another():
+    return (not worker_budget
+            or time.time() - t_start + first_cost * 1.5 + 30
+            < worker_budget)
+
+  def race(label, env):
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
     try:
-      engines['sort+fused'] = measure()
+      engines[label] = measure()
     except Exception as e:  # keep the measured headline on any failure
-      engines['sort+fused_error'] = str(e)[:200]
+      engines[label + '_error'] = str(e)[:200]
     finally:
-      os.environ.pop('GLT_FUSED_HOP', None)
-  best = max((v, k) for k, v in engines.items()
-             if isinstance(v, float))
+      for k, v in saved.items():
+        if v is None:
+          os.environ.pop(k, None)
+        else:
+          os.environ[k] = v
+
+  if (dedup_engine() == 'sort' and not fused_hops()
+      and 'GLT_FUSED_HOP' not in os.environ and room_for_another()):
+    race('sort+fused', {'GLT_FUSED_HOP': '1'})
+  if ('GLT_HOP_ENGINE' not in os.environ
+      and os.environ.get('GLT_WINDOW_HOP', '0') not in ('1', 'true')
+      and dev.platform == 'tpu' and room_for_another()):
+    from glt_tpu.ops.pallas_kernels import pallas_available
+    if pallas_available():
+      # ride the best dedup config measured so far, PINNING the fused
+      # knob explicitly — auto-fusing would otherwise silently stay on
+      # and the label would misattribute the fused delta to pallas
+      if ('sort+fused' in engines and base_label != 'sort+fused'
+          and isinstance(engines['sort+fused'], dict)):
+        ride_fused = (engines['sort+fused']['edges_per_sec']
+                      > engines[base_label]['edges_per_sec'])
+      else:
+        ride_fused = fused_hops()  # what the base run actually used
+      label = (dedup_engine() + ('+fused' if ride_fused else '')
+               + '+pallas')
+      race(label, {'GLT_HOP_ENGINE': 'pallas',
+                   'GLT_FUSED_HOP': '1' if ride_fused else '0'})
+  best = max((v['edges_per_sec'], k) for k, v in engines.items()
+             if isinstance(v, dict))
   eps, chosen = best
 
   # End-to-end train-step throughput, per-batch vs superstep engines
@@ -217,7 +299,10 @@ def run_worker():
   _emit(round(eps, 1), round(eps / A100_ASSUMED_EDGES_PER_SEC, 4),
         backend=dev.platform, scan=scan, iters=ITERS, batch=BATCH,
         engine=chosen,
-        engines={k: (round(v, 1) if isinstance(v, float) else v)
+        engines={k: ({'edges_per_sec': round(v['edges_per_sec'], 1),
+                      'compile_s': round(v['compile_s'], 2),
+                      'steady_recompiles': v['steady_recompiles']}
+                     if isinstance(v, dict) else v)
                  for k, v in engines.items()},
         train_steps_per_sec=train_ab)
 

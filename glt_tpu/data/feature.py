@@ -22,6 +22,7 @@ array is addressable from every chip, and the distributed feature store
 """
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -31,14 +32,17 @@ import numpy as np
 from ..utils import as_numpy
 
 
-@jax.jit
+@functools.partial(jax.jit, static_argnames=('row_gather',))
 def _mixed_gather(hot: jax.Array, cold: jax.Array,
-                  rows: jax.Array) -> jax.Array:
+                  rows: jax.Array, row_gather=None) -> jax.Array:
   """hot [H, D] device block; cold [C, D] pinned-host block; rows [B]
   absolute row indices (cold row r lives at cold[r - H]). Index
   arithmetic stays on device; the cold read runs host-side via raw
   indexing (bounds ops would materialize device-space constants inside
-  the host region)."""
+  the host region). ``row_gather`` (static: keyed by identity in the
+  jit cache) overrides the HOT-block gather kernel — the same seam as
+  Feature.device_gather, so an injected kernel covers offloaded stores
+  too."""
   from jax.experimental import compute_on
   h = hot.shape[0]
   cold_idx = jnp.clip(rows - h, 0, cold.shape[0] - 1)
@@ -48,7 +52,9 @@ def _mixed_gather(hot: jax.Array, cold: jax.Array,
   c = jax.device_put(c, jax.memory.Space.Device)
   if h == 0:  # static shape: the whole table is cold
     return c
-  x = jnp.take(hot, jnp.where(rows < h, rows, 0), axis=0)
+  safe = jnp.where(rows < h, rows, 0)
+  x = (row_gather(hot, safe) if row_gather is not None
+       else jnp.take(hot, safe, axis=0))
   return jnp.where((rows >= h)[:, None], c.astype(x.dtype), x)
 
 
@@ -87,11 +93,18 @@ class Feature:
   def __init__(self, feats, split_ratio: float = 1.0,
                id2index: Optional[np.ndarray] = None,
                device: Optional[jax.Device] = None,
-               dtype=None, host_offload: Optional[bool] = None):
+               dtype=None, host_offload: Optional[bool] = None,
+               row_gather=None):
     feats = as_numpy(feats)
     if feats.ndim == 1:
       feats = feats[:, None]
     self._host_full = feats
+    # optional (table [N, D], rows [B]) -> [B, D] override for the
+    # device-resident gather — the same injection seam the sharded
+    # stores expose (parallel/dist_feature.py): tests pass the
+    # interpret-mode Pallas kernel, deployments can pin a tuned one.
+    # Resolved through ops.pallas_kernels.resolve_row_gather.
+    self.row_gather = row_gather
     self.split_ratio = float(split_ratio)
     self.hot_count = int(round(feats.shape[0] * self.split_ratio))
     self.device = device
@@ -192,26 +205,37 @@ class Feature:
     self.lazy_init()
     return jnp.take(self._id2index_dev, ids, mode='clip')
 
-  def device_gather(self, rows: jax.Array) -> jax.Array:
+  def device_gather(self, rows: jax.Array,
+                    row_gather=None) -> jax.Array:
     """Jit-safe gather; only valid when fully device resident (hot==all).
-    ``rows`` are post-id2index row indices. With GLT_USE_PALLAS=1 on a
-    TPU backend the Pallas row-gather kernel serves this path."""
+    ``rows`` are post-id2index row indices. Gather selection follows
+    ``resolve_row_gather``: an explicit ``row_gather`` (call-site or the
+    store's own) wins, else the Pallas row-gather kernel when
+    GLT_USE_PALLAS=1 on a TPU backend, else ``jnp.take``."""
     self.lazy_init()
-    from ..ops.pallas_kernels import gather_rows, use_pallas_default
-    if use_pallas_default():
-      return gather_rows(self._hot, rows.reshape(-1)).reshape(
+    from ..ops.pallas_kernels import resolve_row_gather
+    fn = resolve_row_gather(row_gather if row_gather is not None
+                            else self.row_gather)
+    if fn is not None:
+      return fn(self._hot, rows.reshape(-1)).reshape(
           rows.shape + (self._hot.shape[1],))
     return jnp.take(self._hot, rows, axis=0, mode='clip')
 
-  def gather_mixed(self, rows: jax.Array) -> jax.Array:
+  def gather_mixed(self, rows: jax.Array,
+                   row_gather=None) -> jax.Array:
     """Jit-served gather over BOTH residency classes: hot rows from the
     device block, cold rows from the pinned-host block via a
     compute_on('device_host') gather — one compiled program, no host
     phase between batches. Requires the offloaded cold block
-    (``cold_array``); loaders fall back to gather_cold_host otherwise."""
+    (``cold_array``); loaders fall back to gather_cold_host otherwise.
+    ``row_gather`` (call-site, else the store's own) overrides the
+    hot-block gather kernel; unlike ``device_gather`` the env default
+    (GLT_USE_PALLAS) does not apply here — only explicit injections."""
     self.lazy_init()
     assert self.cold_array is not None, 'host offload inactive'
-    return _mixed_gather(self._hot, self.cold_array, rows)
+    fn = row_gather if row_gather is not None else self.row_gather
+    return _mixed_gather(self._hot, self.cold_array, rows,
+                         row_gather=fn)
 
   def cold_block_numpy(self) -> np.ndarray:
     """The whole cold block as numpy, whichever residency holds it
@@ -328,24 +352,28 @@ class Feature:
     return out
 
 
-def gather_features(feat: Optional[Feature], node) -> Optional[jax.Array]:
+def gather_features(feat: Optional[Feature], node,
+                    row_gather=None) -> Optional[jax.Array]:
   """Batch gather over a Feature across BOTH residency classes — the
   single collate-time gather path shared by the training loaders
   (loader.node_loader) and the online serving engine (serving.engine).
   Hot rows stay on device; cold rows ride the pinned-host block
-  (gather_mixed) when offloaded, else the host phase."""
+  (gather_mixed) when offloaded, else the host phase. ``row_gather``
+  overrides the device-resident gather kernel at the call site (see
+  :meth:`Feature.device_gather`) — it survives feature swaps (e.g.
+  stream snapshot updates) because it rides the call, not the store."""
   if feat is None:
     return None
   rows = feat.map_ids(node)
   if feat.fully_device_resident:
-    return feat.device_gather(rows)
+    return feat.device_gather(rows, row_gather=row_gather)
   feat.lazy_init()  # offload is decided at placement time
   if feat.cold_array is not None:
     # host-offloaded cold block: one jitted program serves both
     # residency classes (compute_on host gather inside) — no host
     # phase between batches at all (jnp.asarray is a no-op for rows
     # already on device)
-    return feat.gather_mixed(jnp.asarray(rows))
+    return feat.gather_mixed(jnp.asarray(rows), row_gather=row_gather)
   # legacy mixed residency (host_offload=False): hot rows stay on
   # device end-to-end; only the cold slice crosses host->device (the
   # UVA-read analogue). The previous design pulled the hot gather D2H
@@ -359,7 +387,7 @@ def gather_features(feat: Optional[Feature], node) -> Optional[jax.Array]:
                        .astype(feat.dtype))
   rows_dev = jnp.asarray(rows_np)
   hot = jnp.where(rows_dev < feat.hot_count, rows_dev, 0)
-  x = feat.device_gather(hot)                  # [B, D], cold lanes junk
+  x = feat.device_gather(hot, row_gather=row_gather)  # cold lanes junk
   cold_idx = np.nonzero(rows_np >= feat.hot_count)[0]
   if cold_idx.size:
     cold_vals = feat.gather_cold_host(rows_np[cold_idx]) \

@@ -164,15 +164,37 @@ class Graph:
             have = (width, None)
           else:
             # logical prefix: when growing an existing padded copy the
-            # stored array already carries the previous width's tail
-            a = jnp.asarray(a)[:self.num_edges]
-            padded = jnp.concatenate(
-                [a, jnp.full((width,), fills[f], a.dtype)])
+            # stored array already carries the previous width's tail.
+            # Samplers call this at TRACE time (one_hop closures), so
+            # the pad must evaluate eagerly — a staged concatenate
+            # would rebind self._<f> to a tracer that leaks into the
+            # next compiled program (multi-bucket serving traces the
+            # same graph more than once).
+            with jax.ensure_compile_time_eval():
+              a = jnp.asarray(a)[:self.num_edges]
+              padded = jnp.concatenate(
+                  [a, jnp.full((width,), fills[f], a.dtype)])
             setattr(self, '_' + f, padded)  # supersede: one HBM copy
             have = (width, padded)
           self._window_cache[f] = have
         out[f] = have[1]
     return out
+
+  def hub_count(self, width: int) -> int:
+    """Number of rows with degree > ``width`` — the exact hub capacity
+    ``H`` of the windowed sampling paths (``sample_neighbors``'s
+    ``window=(W, H)``): derived host-side from the true degree
+    distribution, once per width, so the bit-identical window/pallas
+    guarantee is unconditional. Cached alongside the window arrays
+    (same lock; cheap per-width recompute on unpickle)."""
+    with self._window_lock:
+      key = ('hub_count', int(width))
+      have = self._window_cache.get(key)
+      if have is None:
+        deg = np.diff(self.topo.indptr)
+        have = int((deg > int(width)).sum())
+        self._window_cache[key] = have
+      return have
 
   # -- probes (reference graph.cu:30-48 LookupDegreeKernel) ---------------
 

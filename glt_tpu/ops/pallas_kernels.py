@@ -11,6 +11,15 @@ GatherTensorKernel analogue, unified_tensor.cu:35-81). Uses the canonical
 TPU embedding-gather pattern: row indices are scalar-prefetched so the
 BlockSpec index_map can steer one row-block DMA per grid step, and the
 Pallas pipeline double-buffers those HBM->VMEM copies behind the writes.
+
+``sample_hop``: the one-hop sampling megakernel (the ``pallas`` hop
+engine, ops/pipeline.py::hop_engine). Fuses the per-row CSR window read
+and the fanout pick — the two stages GLT's CUDA samplers keep in one
+kernel (random_sampler.cu:36-165) — so the [S, W] neighbor window never
+round-trips through HBM: each frontier row's window is DMA'd HBM->VMEM
+double-buffered across grid steps, the precomputed Floyd/replace
+offsets pick inside VMEM, and hub rows (degree > W) are fixed up by a
+per-element DMA tail pass folded into the same kernel.
 """
 from __future__ import annotations
 
@@ -35,6 +44,17 @@ def use_pallas_default() -> bool:
     return False
   return (pallas_available()
           and jax.default_backend() == 'tpu')
+
+
+def interpret_default() -> bool:
+  """Whether Pallas kernels must run in interpret mode on this backend:
+  the kernels are Mosaic/TPU programs, so every non-TPU backend (the
+  tier-1 CPU suite, the CI interpret job) executes them through the
+  interpreter. On TPU, GLT_PALLAS_INTERPRET=1 forces interpretation for
+  debugging."""
+  if os.environ.get('GLT_PALLAS_INTERPRET', '') in ('1', 'true', 'True'):
+    return True
+  return jax.default_backend() != 'tpu'
 
 
 def resolve_row_gather(override=None):
@@ -164,3 +184,178 @@ def gather_rows(table: jax.Array, rows: jax.Array,
       interpret=interpret,
   )(rows, table3)
   return out.reshape(b, d)
+
+
+@functools.partial(jax.jit, static_argnames=('width', 'block',
+                                             'interpret'))
+def sample_hop(arr_win: jax.Array,
+               eids_win: 'Optional[jax.Array]',
+               starts: jax.Array,
+               offsets: jax.Array,
+               hub_rows: jax.Array,
+               hub_slots: jax.Array,
+               width: int,
+               block: int = 8,
+               interpret: bool = False):
+  """One-hop sampling megakernel: window DMA + offset pick + hub tail.
+
+  For each frontier row ``i``, DMAs the ``width``-wide CSR window
+  ``arr_win[starts[i] : starts[i]+width]`` HBM->VMEM (double-buffered
+  across grid steps, ``block`` rows' descriptors in flight per slot),
+  applies the precomputed sampling ``offsets`` inside VMEM, and emits
+  the packed ``[S, K]`` neighbor picks — the ``[S, width]`` window never
+  materializes in HBM. Rows listed in ``hub_rows`` (degree > width, so
+  their offsets can exceed the window) are fixed up by a per-element DMA
+  tail pass in the SAME kernel: ``hub_slots`` holds their exact edge
+  slots, and the combine overwrites only those rows.
+
+  Args:
+    arr_win: [E + width] edge array padded per the ``gather_windows``
+      contract — every real row window lies fully inside it, so
+      ``starts`` need no clamping.
+    eids_win: optional second edge array (edge ids) read through the
+      same windows/offsets; pass None to skip the second output.
+    starts: [S] int32 per-row window starts (CSR row offsets).
+    offsets: [S, K] int32 within-row sampling offsets, as drawn by the
+      element path (unclamped; the kernel clips to the window for the
+      main pass — hub rows get exact values from the tail pass).
+    hub_rows: [H] int32 frontier row indices needing exact fix-up; -1
+      marks unused capacity. H is a static cap. Every grid step scans
+      the whole list for rows in its block (O(grid * H) scalar
+      compares), so H must stay small relative to S — pick W so hubs
+      are rare (callers clamp H to the frontier size, and the degree
+      distribution bounds it); a sorted-hub-list + per-block-offset
+      variant is the follow-up if a hardware A/B ever shows the scan.
+    hub_slots: [H, K] int32 exact edge slots for the hub rows (already
+      clipped to the real edge range by the caller).
+
+  Returns ``picks`` [S, K] (and ``eid_picks`` [S, K] when ``eids_win``
+  is given, else None) with the same dtype(s) as the source arrays.
+  Rows beyond the hub cap fall back to window-clipped picks — identical
+  confinement to the XLA window path (ops/sample.py docstring).
+  """
+  from jax.experimental import pallas as pl
+  from jax.experimental.pallas import tpu as pltpu
+
+  s = starts.shape[0]
+  fanout = offsets.shape[1]
+  n_hub = hub_rows.shape[0]
+  with_eids = eids_win is not None
+  if s == 0:
+    empty = jnp.zeros((0, fanout), arr_win.dtype)
+    return empty, (jnp.zeros((0, fanout), eids_win.dtype)
+                   if with_eids else None)
+  starts = starts.astype(jnp.int32)
+  offsets = offsets.astype(jnp.int32)
+  pad = (-s) % block
+  if pad:
+    starts = jnp.pad(starts, (0, pad))
+    offsets = jnp.pad(offsets, ((0, pad), (0, 0)))
+  n_blocks = (s + pad) // block
+  # per-row fix-up flag, derived from the SAME hub list the tail pass
+  # walks — a row is only flagged if a tail DMA will actually fill it
+  # (hub rows past the H cap keep their window picks, the documented
+  # confinement of an undersized cap)
+  valid_hub = (hub_rows >= 0).astype(jnp.int32)
+  hub_flag = jnp.zeros((s + pad, 1), jnp.int32).at[
+      jnp.clip(hub_rows, 0, s + pad - 1), 0].max(valid_hub)
+  hub_rows = jnp.where(valid_hub > 0, hub_rows, -1).astype(jnp.int32)
+  hub_slots = hub_slots.astype(jnp.int32)
+
+  arrs = (arr_win, eids_win) if with_eids else (arr_win,)
+
+  def kernel(starts_ref, hub_rows_ref, hub_slots_ref, offsets_ref,
+             flag_ref, *rest):
+    src_refs = rest[:len(arrs)]
+    out_refs = rest[len(arrs):2 * len(arrs)]
+    win_bufs = rest[2 * len(arrs):3 * len(arrs)]
+    hub_bufs = rest[3 * len(arrs):4 * len(arrs)]
+    sems = rest[4 * len(arrs):5 * len(arrs)]
+    hub_sems = rest[5 * len(arrs):6 * len(arrs)]
+    i = pl.program_id(0)
+
+    def window_dma(a, slot, row, j):
+      st = starts_ref[row]
+      return pltpu.make_async_copy(src_refs[a].at[pl.ds(st, width)],
+                                   win_bufs[a].at[slot, j],
+                                   sems[a].at[slot, j])
+
+    def issue(slot, blk):
+      for j in range(block):
+        for a in range(len(arrs)):
+          window_dma(a, slot, blk * block + j, j).start()
+
+    cur = jax.lax.rem(i, 2)
+    nxt = jax.lax.rem(i + 1, 2)
+
+    @pl.when(i == 0)
+    def _():
+      issue(cur, 0)                 # cold start: first block's windows
+
+    @pl.when(i + 1 < n_blocks)
+    def _():
+      issue(nxt, i + 1)             # double-buffer: next block in flight
+
+    for j in range(block):
+      for a in range(len(arrs)):
+        window_dma(a, cur, i * block + j, j).wait()
+
+    # hub tail pass: exact per-element reads for rows whose degree
+    # exceeds the window, folded into the owning block's grid step
+    def hub_issue(h, _):
+      row = hub_rows_ref[h]
+      in_block = (row >= i * block) & (row < (i + 1) * block)
+
+      @pl.when(in_block)
+      def _():
+        j = row - i * block
+        for k in range(fanout):
+          sl = hub_slots_ref[h, k]
+          for a in range(len(arrs)):
+            pltpu.make_async_copy(src_refs[a].at[pl.ds(sl, 1)],
+                                  hub_bufs[a].at[j, pl.ds(k, 1)],
+                                  hub_sems[a].at[j, k]).start()
+        for k in range(fanout):
+          sl = hub_slots_ref[h, k]
+          for a in range(len(arrs)):
+            pltpu.make_async_copy(src_refs[a].at[pl.ds(sl, 1)],
+                                  hub_bufs[a].at[j, pl.ds(k, 1)],
+                                  hub_sems[a].at[j, k]).wait()
+      return 0
+
+    jax.lax.fori_loop(0, n_hub, hub_issue, 0)
+
+    woff = jnp.minimum(offsets_ref[...], width - 1)      # [block, K]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (block, fanout, width), 2)
+    onehot = iota == woff[:, :, None]
+    is_hub = flag_ref[...] != 0                          # [block, 1]
+    for a in range(len(arrs)):
+      win = win_bufs[a][cur]                             # [block, W]
+      zero = jnp.zeros((), win.dtype)
+      picks = jnp.sum(jnp.where(onehot, win[:, None, :], zero), axis=-1)
+      out_refs[a][...] = jnp.where(is_hub, hub_bufs[a][...], picks)
+
+  grid_spec = pltpu.PrefetchScalarGridSpec(
+      num_scalar_prefetch=3,
+      grid=(n_blocks,),
+      in_specs=(
+          [pl.BlockSpec((block, fanout), lambda i, *_: (i, 0)),
+           pl.BlockSpec((block, 1), lambda i, *_: (i, 0))]
+          + [pl.BlockSpec(memory_space=pl.ANY)] * len(arrs)),
+      out_specs=[pl.BlockSpec((block, fanout), lambda i, *_: (i, 0))
+                 for _ in arrs],
+      scratch_shapes=(
+          [pltpu.VMEM((2, block, width), a.dtype) for a in arrs]
+          + [pltpu.VMEM((block, fanout), a.dtype) for a in arrs]
+          + [pltpu.SemaphoreType.DMA((2, block)) for _ in arrs]
+          + [pltpu.SemaphoreType.DMA((block, fanout)) for _ in arrs]),
+  )
+  outs = pl.pallas_call(
+      kernel,
+      grid_spec=grid_spec,
+      out_shape=[jax.ShapeDtypeStruct((s + pad, fanout), a.dtype)
+                 for a in arrs],
+      interpret=interpret,
+  )(starts, hub_rows, hub_slots, offsets, hub_flag, *arrs)
+  picks = outs[0][:s]
+  return picks, (outs[1][:s] if with_eids else None)

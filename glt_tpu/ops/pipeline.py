@@ -60,6 +60,46 @@ def fused_hops() -> bool:
   return mode in ('1', 'true')
 
 
+#: registered one-hop neighbor-read engines (sampler-side dispatch —
+#: distinct from the dedup engines above, which pick the inducer)
+HOP_ENGINES = ('element', 'window', 'pallas')
+
+
+def hop_engine() -> str:
+  """How the samplers read neighbor values inside a uniform hop:
+
+  * ``element`` — [S, fanout] per-element random gather (the XLA
+    baseline; every backend).
+  * ``window``  — [S, W] contiguous per-row window read via
+    ``lax.gather`` + exact hub fix-up (ops/sample.py window path).
+  * ``pallas``  — the one-hop megakernel: window DMA + offset pick +
+    hub tail pass fused in one Pallas kernel
+    (ops/pallas_kernels.py::sample_hop). Off-TPU backends run it in
+    interpret mode (parity/CI); only a TPU backend runs it compiled.
+
+  ``GLT_HOP_ENGINE`` selects; ``auto`` (the default) is ``element``
+  until the hardware A/B (bench.py races the engines and records the
+  winner in its ``engines{}``) justifies flipping the default. All
+  three engines draw offsets from the same ``jax.random`` stream, so
+  results are bit-identical (ops/sample.py). Read at trace time, like
+  :func:`dedup_engine`."""
+  mode = os.environ.get('GLT_HOP_ENGINE', 'auto')
+  if mode not in ('auto',) + HOP_ENGINES:
+    raise ValueError(
+        f'GLT_HOP_ENGINE={mode!r}: expected auto|element|window|pallas')
+  if mode == 'auto':
+    return 'element'
+  if mode == 'pallas':
+    from .pallas_kernels import pallas_available
+    if not pallas_available():
+      import logging
+      logging.getLogger(__name__).warning(
+          'GLT_HOP_ENGINE=pallas but jax.experimental.pallas is '
+          'unavailable; falling back to the window engine')
+      return 'window'
+  return mode
+
+
 def checksum_outputs(out: Dict[str, jax.Array]) -> jax.Array:
   """Fold every multihop output into one scalar so no pipeline stage is
   dead code under jit. Benchmarks that return only an edge-count

@@ -106,6 +106,8 @@ def sample_neighbors(
     window: Optional[tuple] = None,
     indices_win: Optional[jax.Array] = None,
     edge_ids_win: Optional[jax.Array] = None,
+    engine: Optional[str] = None,
+    interpret: bool = False,
 ) -> NeighborOutput:
   """Uniformly sample up to ``fanout`` neighbors per seed from a CSR/CSC.
 
@@ -122,13 +124,35 @@ def sample_neighbors(
   random gather, with the up-to-``H`` hub rows (degree > W) fixed up by
   an exact [H, fanout] element gather. Offsets are drawn identically in
   both paths, so results are BIT-IDENTICAL to the element path provided
-  ``H >= number of hub rows in the frontier`` — callers derive H from
-  the graph's true hub count (host-side, once) so the guarantee is
-  unconditional. Requires ``indices_win``: the same indices array with
-  >= W trailing padding slots (Graph.window_arrays / a one-time host
-  pad); ``edge_ids_win`` likewise when ``edge_ids`` is passed.
+  ``H >= number of hub ROWS in the frontier`` (a hub node occurring
+  twice needs two fix-up slots) — the samplers derive H from the
+  graph's true hub count (host-side, once), which bounds the row count
+  because their internal frontiers are deduplicated/masked, so the
+  guarantee is unconditional there; direct callers passing frontiers
+  with duplicate hub ids must size H for the duplicates. An EAGER call
+  (concrete arrays, outside jit) with an
+  undersized H raises ValueError, while traced calls keep the
+  documented confinement (only unfixed hub rows deviate). Requires
+  ``indices_win``: the same indices array with >= W trailing padding
+  slots (Graph.window_arrays / a one-time host pad); ``edge_ids_win``
+  likewise when ``edge_ids`` is passed.
+
+  ``engine`` picks the window-read implementation (see
+  ops/pipeline.py::hop_engine): ``'window'`` (default when ``window``
+  is given) keeps the XLA slice-gather path; ``'pallas'`` routes the
+  window read + offset pick + hub fix-up through the fused one-hop
+  megakernel (ops/pallas_kernels.py::sample_hop, ``interpret`` for
+  off-TPU parity runs); ``'element'`` ignores ``window``. Offsets come
+  from the same draw in every engine, so outputs stay bit-identical.
   """
   assert fanout > 0, 'fanout must be a static positive int'
+  if engine is None:
+    engine = 'window' if window is not None else 'element'
+  assert engine in ('element', 'window', 'pallas'), engine
+  if engine == 'element':
+    window = None
+  else:
+    assert window is not None, f"engine={engine!r} needs window=(W, H)"
   seeds = seeds.astype(indptr.dtype)
   num_edges = indices.shape[0]
   if num_edges == 0:  # legitimately empty (e.g. a rare-etype partition)
@@ -160,6 +184,37 @@ def sample_neighbors(
     assert indices_win is not None, (
         'window read path needs indices_win (W-padded indices); pass '
         'Graph.window_arrays()["indices"] or pad host-side once')
+    if not isinstance(deg, jax.core.Tracer):
+      # eager call: the docstring guarantee is checkable — fail loudly
+      # instead of silently truncating hub rows past the H capacity
+      true_hubs = int((deg > w_width).sum())
+      if true_hubs > n_hub:
+        raise ValueError(
+            f'window=(W={w_width}, H={n_hub}) underestimates the '
+            f'frontier hub count: {true_hubs} ROWS have degree > W '
+            '(a repeated hub seed counts once per occurrence). '
+            'Graph.hub_count(W) bounds this for deduplicated/masked '
+            'frontiers — the samplers\' internal hops; raise H to the '
+            'frontier size for duplicate-bearing eager calls.')
+    if engine == 'pallas':
+      from .pallas_kernels import sample_hop
+      assert edge_ids is None or edge_ids_win is not None, (
+          'pallas engine with edge_ids needs edge_ids_win (the W-padded '
+          'edge-id array, Graph.window_arrays()["edge_ids"])')
+      if n_hub > 0 and seeds.shape[0] > 0:
+        hub_idx = jnp.nonzero(deg > w_width, size=n_hub,
+                              fill_value=-1)[0].astype(jnp.int32)
+        hub_slots = jnp.take(slots, jnp.maximum(hub_idx, 0),
+                             axis=0).astype(jnp.int32)      # [H, K]
+      else:  # static dummy row: -1 never matches a block
+        hub_idx = jnp.full((1,), -1, jnp.int32)
+        hub_slots = jnp.zeros((1, fanout), jnp.int32)
+      nbrs, eid_picks = sample_hop(
+          indices_win, edge_ids_win if edge_ids is not None else None,
+          start.astype(jnp.int32), offsets, hub_idx, hub_slots,
+          width=w_width, interpret=interpret)
+      eids = eid_picks if edge_ids is not None else slots
+      return NeighborOutput(nbrs=nbrs, mask=mask, eids=eids)
     win = _gather_row_windows(indices_win, start, w_width)   # [S, W]
     woff = jnp.minimum(offsets, w_width - 1)
     nbrs = jnp.take_along_axis(win, woff, axis=1)
@@ -168,7 +223,8 @@ def sample_neighbors(
       eids = jnp.take_along_axis(ewin, woff, axis=1)
     else:
       eids = slots
-    if n_hub > 0:  # exact fix-up: element-gather only the hub rows
+    if n_hub > 0 and seeds.shape[0] > 0:
+      # exact fix-up: element-gather only the hub rows
       hub_idx = jnp.nonzero(deg > w_width, size=n_hub,
                             fill_value=0)[0]                 # [H]
       hub_ok = jnp.take(deg, hub_idx) > w_width              # fill rows F

@@ -19,6 +19,7 @@ traversal type itself.
 from __future__ import annotations
 
 import logging
+import os
 from typing import Dict, List, Optional, Union
 
 import jax
@@ -27,8 +28,8 @@ import numpy as np
 
 from ..data import Graph
 from ..ops.pipeline import dedup_engine, edge_hop_offsets, \
-    hetero_edge_hop_offsets, make_dedup_tables, multihop_sample, \
-    multihop_sample_hetero
+    hetero_edge_hop_offsets, hop_engine, make_dedup_tables, \
+    multihop_sample, multihop_sample_hetero
 from ..ops.sample import (
     neighbor_probs, sample_full_neighbors, sample_neighbors,
     sample_neighbors_weighted,
@@ -192,6 +193,35 @@ class NeighborSampler(BaseSampler):
     return dict(window_gather=lambda arr, st, w: fn(arr, st, width=w),
                 window_sources=sources)
 
+  def _uniform_hop_kwargs(self, g: Graph, frontier_size: int):
+    """Windowed-engine plumbing for the UNIFORM hop read
+    (ops/pipeline.py::hop_engine, read at trace time): resolves the
+    window width (``GLT_WINDOW_W``, default 96, floored at 8), the
+    exact hub capacity from the graph's true degree distribution
+    (:meth:`Graph.hub_count` — host-side, once per width), and the
+    W-padded edge arrays. Returns {} on the element engine or when the
+    padded arrays are unavailable (HOST-mode graphs). Tests inject an
+    engine/interpret override via ``_hop_engine_override``."""
+    eng = getattr(self, '_hop_engine_override', None) or hop_engine()
+    if eng == 'element':
+      return {}
+    width = max(int(os.environ.get('GLT_WINDOW_W', '96')), 8)
+    fields = ('indices', 'edge_ids') if (
+        self.with_edge and g.topo.edge_ids is not None) else ('indices',)
+    sources = g.window_arrays(width, fields)
+    if any(sources.get(f) is None for f in fields):
+      return {}  # HOST-mode (or missing) edge arrays: XLA fallback
+    # a frontier can't hold more hub rows than it has rows: clamping H
+    # keeps the fix-up buffers frontier-sized without ever undershooting
+    n_hub = min(g.hub_count(width), int(frontier_size))
+    kw = dict(window=(width, n_hub),
+              indices_win=sources['indices'],
+              edge_ids_win=sources.get('edge_ids'), engine=eng)
+    if eng == 'pallas':
+      from ..ops.pallas_kernels import interpret_default
+      kw['interpret'] = interpret_default()
+    return kw
+
   def _one_hop(self, g: Graph, frontier, fanout, key, mask):
     """Dispatch full/uniform/weighted one-hop sampling on graph ``g``."""
     if fanout < 0:  # full neighborhood inside a |fanout|-wide window
@@ -215,10 +245,13 @@ class NeighborSampler(BaseSampler):
       return sample_neighbors_weighted(
           g.indptr, g.indices, g.edge_weights, frontier, fanout, key,
           max_degree=max_deg, seed_mask=mask, edge_ids=eids, **wk)
+    # build window kwargs BEFORE touching g.indices/edge_ids (same
+    # one-resident-copy rule as the full-neighborhood branch above)
+    wk = self._uniform_hop_kwargs(g, frontier.shape[0])
     eids = g.edge_ids if self.with_edge else None
     return sample_neighbors(
         g.indptr, g.indices, frontier, fanout, key, seed_mask=mask,
-        edge_ids=eids, replace=self.replace)
+        edge_ids=eids, replace=self.replace, **wk)
 
   # -- homogeneous sampling ---------------------------------------------
 

@@ -60,6 +60,11 @@ class InferenceEngine:
       NeighborSampler over ``data.graph`` — how live-update serving
       plugs in a :class:`~glt_tpu.stream.StreamSampler` (whose jitted
       programs survive snapshot swaps; see ``update_snapshot``).
+    row_gather: optional (table [N, D], rows [B]) -> [B, D] override
+      for the serving feature gather (resolve_row_gather seam — tests
+      inject the interpret-mode Pallas kernel). Applied at the gather
+      CALL SITE, so it keeps serving after ``update_snapshot`` swaps
+      in a new stream Feature.
   """
 
   def __init__(self, data: Dataset, model, params,
@@ -71,7 +76,8 @@ class InferenceEngine:
                seed: Optional[int] = 0,
                apply_fn: Optional[Callable] = None,
                with_edge: bool = False,
-               sampler=None):
+               sampler=None,
+               row_gather=None):
     assert not isinstance(data.graph, dict), (
         'serving engine is homogeneous-only for now (hetero serving '
         'needs per-type bucket grids)')
@@ -86,6 +92,7 @@ class InferenceEngine:
     self.sampler = sampler if sampler is not None else NeighborSampler(
         data.graph, list(num_neighbors), edge_dir=data.edge_dir,
         with_edge=with_edge, seed=seed)
+    self.row_gather = row_gather
     self._apply_fn = apply_fn or (
         lambda params, batch: self.model.apply(params, batch))
     self._fwd = {}            # bucket -> jitted forward
@@ -163,7 +170,8 @@ class InferenceEngine:
     it (public so param init / benchmarks build batches through the
     same pipeline instead of re-rolling it)."""
     out = self.sampler.sample_from_nodes(seeds, n_valid=n_valid)
-    x = gather_features(self.data.get_node_feature(), out.node)
+    x = gather_features(self.data.get_node_feature(), out.node,
+                        row_gather=self.row_gather)
     # metadata carries per-call arrays (seed labels) — stripping it
     # keeps the forward's pytree signature identical across calls
     return to_batch(out, x=x, batch_size=bucket).replace(metadata=None)

@@ -885,6 +885,7 @@ def test_dist_hetero_sampler_sort_engine(tmp_path_factory, mesh,
   assert ('item', 'rev_u2i', 'user') in out['row']
 
 
+@pytest.mark.pallas
 def test_dist_feature_pallas_row_gather_parity(mesh, dist_datasets):
   # injected interpret-mode Pallas serving gather == XLA take through
   # the PB-routed all_to_all lookup

@@ -152,6 +152,7 @@ def test_sharded_segment_mean_scattered_matches_global(mesh):
   np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.pallas
 def test_sharded_feature_pallas_row_gather_parity(mesh):
   # the injected interpret-mode Pallas row gather must serve identical
   # rows to the XLA take through the full all_to_all lookup

@@ -141,6 +141,7 @@ def test_neighbor_probs_hotness():
   np.testing.assert_allclose(np.asarray(probs), [0.0, 0.5, 0.5])
 
 
+@pytest.mark.pallas
 def test_pallas_gather_rows_parity():
   """Interpret-mode parity of the Pallas feature gather vs jnp.take."""
   from glt_tpu.ops.pallas_kernels import gather_rows
@@ -152,6 +153,7 @@ def test_pallas_gather_rows_parity():
                              np.asarray(table)[np.asarray(rows)])
 
 
+@pytest.mark.pallas
 def test_pallas_gather_rows_clamps():
   from glt_tpu.ops.pallas_kernels import gather_rows
   table = jnp.arange(12.0).reshape(3, 4)
@@ -196,6 +198,7 @@ def test_multihop_sample_many_matches_single():
   assert got == {7, 8, 9, 10}
 
 
+@pytest.mark.pallas
 def test_pallas_gather_windows_parity():
   from glt_tpu.ops.pallas_kernels import gather_windows
   rng = np.random.default_rng(3)
@@ -208,6 +211,7 @@ def test_pallas_gather_windows_parity():
   np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.pallas
 def test_pallas_gather_windows_block_padding():
   # row count not a multiple of the block: the pad rows must not leak
   from glt_tpu.ops.pallas_kernels import gather_windows
@@ -220,6 +224,7 @@ def test_pallas_gather_windows_block_padding():
   np.testing.assert_array_equal(got[2], np.arange(84, 100))
 
 
+@pytest.mark.pallas
 @pytest.mark.parametrize('engine', ['table', 'sort'])
 def test_window_dma_path_matches_xla_weighted_and_full(monkeypatch,
                                                        engine):
@@ -254,6 +259,7 @@ def test_window_dma_path_matches_xla_weighted_and_full(monkeypatch,
     np.testing.assert_array_equal(a[k], b[k], err_msg=k)
 
 
+@pytest.mark.pallas
 @pytest.mark.parametrize('engine', ['table', 'sort'])
 def test_window_dma_path_matches_xla_full_neighborhood(monkeypatch,
                                                        engine):

@@ -108,6 +108,45 @@ def test_replace_path(graph):
   _assert_identical(base, winp)
 
 
+def test_all_hub_frontier():
+  # every row's degree exceeds W: the whole batch rides the fix-up
+  degrees = np.full(6, 3 * W, np.int64)
+  g = _csr(degrees)
+  key = jax.random.key(6)
+  _assert_identical(_run(g, key, window=None),
+                    _run(g, key, window=(W, 6)))
+
+
+def test_window_at_least_max_degree_has_zero_hubs(graph):
+  # W >= max degree: H=0 is sufficient, no fix-up rows at all
+  indptr, indices = graph
+  max_deg = int(np.max(np.asarray(indptr[1:] - indptr[:-1])))
+  seeds = jnp.arange(indptr.shape[0] - 1, dtype=jnp.int32)
+  key = jax.random.key(7)
+  base = sample_neighbors(indptr, indices, seeds, K, key)
+  winp = sample_neighbors(
+      indptr, indices, seeds, K, key, window=(max_deg, 0),
+      indices_win=_padded(indices, max_deg))
+  _assert_identical(base, winp)
+
+
+def test_empty_frontier(graph):
+  indptr, indices = graph
+  out = sample_neighbors(indptr, indices, jnp.zeros((0,), jnp.int32),
+                         K, jax.random.key(8), window=(W, 2),
+                         indices_win=_padded(indices, W))
+  assert out.nbrs.shape == (0, K)
+  assert out.mask.shape == (0, K)
+  assert int(out.nbrs_num.sum()) == 0
+
+
+def test_undersized_hub_capacity_raises_eagerly(graph):
+  # the docstring guarantee (H >= true hub count) is now CHECKED on
+  # eager calls: 2 hubs in this frontier, H=1 must fail loudly
+  with pytest.raises(ValueError, match='underestimates'):
+    _run(graph, jax.random.key(9), window=(W, 1))
+
+
 def test_jit_and_undersized_hub_capacity_only_affects_hubs(graph):
   # H smaller than the hub count: non-hub rows must still be exact
   # (the documented failure mode is confined to unfixed hub rows)
