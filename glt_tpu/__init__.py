@@ -23,5 +23,6 @@ from . import channel  # noqa: F401
 from . import partition  # noqa: F401
 from . import parallel  # noqa: F401
 from . import distributed  # noqa: F401
+from . import resilience  # noqa: F401
 from . import serving  # noqa: F401
 from . import stream  # noqa: F401

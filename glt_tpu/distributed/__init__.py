@@ -31,7 +31,8 @@ from .dist_server import (
     wait_and_shutdown_server,
 )
 from .dist_client import (
-    async_request_server, init_client, request_server, shutdown_client,
+    async_request_server, fabric_stats, init_client, request_server,
+    request_with_failover, set_replicas, shutdown_client,
 )
 
 __all__ += [
@@ -42,7 +43,8 @@ __all__ += [
     'DistServer', 'init_server', 'shutdown_server',
     'wait_and_shutdown_server',
     'async_request_server', 'init_client', 'request_server',
-    'shutdown_client',
+    'shutdown_client', 'request_with_failover', 'set_replicas',
+    'fabric_stats',
 ]
 from .dist_hetero import DistHeteroGraph, DistHeteroNeighborSampler, \
     DistHeteroTrainStep
@@ -70,7 +72,7 @@ __all__ += ['dist_hetero_graph_from_partitions_multihost']
 
 __all__ += ['dist_feature_from_partitions_multihost']
 
-from .dist_feature import PartialFeature
+from .dist_feature import PartialFeature, resilient_cold_fetcher
 from .dist_random_partitioner import DistTableRandomPartitioner
 from .rpc import (
     RpcCalleeBase, RpcClient, RpcDataPartitionRouter, RpcServer,
@@ -82,7 +84,8 @@ from .rpc import (
 )
 
 __all__ += [
-    'PartialFeature', 'DistTableRandomPartitioner', 'get_server',
+    'PartialFeature', 'resilient_cold_fetcher',
+    'DistTableRandomPartitioner', 'get_server',
     'RpcCalleeBase', 'RpcClient', 'RpcDataPartitionRouter', 'RpcServer',
     'all_gather', 'barrier', 'get_rpc_master_addr',
     'get_rpc_master_port', 'global_all_gather', 'global_barrier',

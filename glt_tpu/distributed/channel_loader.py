@@ -162,14 +162,35 @@ class RemoteNeighborLoader:
     self._epoch = 0
     self._epoch_active = 0
 
+    self.degraded_servers: set = set()
+
     def make_fetcher(rank):
       def fetch():
         # passes the epoch this iteration belongs to; a stale puller
         # surviving an abandoned epoch gets #STALE back (server-side
-        # guard) instead of consuming a live batch
-        out = dist_client.request_server(
-            rank, 'fetch_one_sampled_message', self.worker_key,
-            self._epoch_active)
+        # guard) instead of consuming a live batch. The per-request
+        # deadline keeps a wedged (not dead) server from holding the
+        # puller past the rpc budget.
+        try:
+          out = dist_client.request_server(
+              rank, 'fetch_one_sampled_message', self.worker_key,
+              self._epoch_active,
+              _rpc_timeout=self.options.rpc_timeout)
+        except (ConnectionError, OSError) as e:
+          # rpc retry + breaker already ran their course: the server is
+          # gone. Degrade (finish the epoch minus this server) or
+          # re-raise per policy — never hang.
+          if not self.options.degrade_on_server_failure:
+            raise
+          if rank not in self.degraded_servers:
+            self.degraded_servers.add(rank)
+            dist_client.record_server_dropout(rank)
+            import logging
+            logging.getLogger(__name__).warning(
+                'server %d lost mid-epoch (%s); continuing with %d '
+                'surviving server(s)', rank, e,
+                len(self.server_ranks) - len(self.degraded_servers))
+          raise StopIteration
         if out in (b'#EPOCH_END', b'#STALE'):
           raise StopIteration
         return unpack_message(out)
@@ -189,8 +210,18 @@ class RemoteNeighborLoader:
     self._epoch += 1
     self._epoch_active = epoch
     for rank in self.server_ranks:
-      dist_client.request_server(rank, 'start_new_epoch_sampling',
-                                 self.worker_key, epoch)
+      try:
+        dist_client.request_server(rank, 'start_new_epoch_sampling',
+                                   self.worker_key, epoch)
+      except (ConnectionError, OSError):
+        # a server that died BETWEEN epochs: its fetcher will observe
+        # the same failure and degrade; a recovered server re-arms on
+        # the next epoch
+        if not self.options.degrade_on_server_failure:
+          raise
+        if rank not in self.degraded_servers:
+          self.degraded_servers.add(rank)
+          dist_client.record_server_dropout(rank)
     self.channel.reset()
     while True:
       try:

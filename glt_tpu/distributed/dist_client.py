@@ -4,39 +4,192 @@ Reference: graphlearn_torch/python/distributed/dist_client.py (101):
 init_client, request_server/async_request_server, and the ordered
 shutdown choreography (client barrier -> client 0 tells servers to exit
 -> teardown, :57-79).
+
+Resilience (docs/fault_tolerance.md): every server connection rides the
+hardened :class:`~glt_tpu.distributed.rpc.RpcClient` (reconnect,
+idempotent retry, per-peer circuit breaker), a background
+:class:`~glt_tpu.resilience.HealthMonitor` publishes per-server
+UP/DEGRADED/DOWN, and remote feature lookups fail over to replica
+partitions (``set_replicas``) or degrade to the bounded-staleness
+cache + zero-fill answer — counted, never silent.
 """
 from __future__ import annotations
 
-from typing import Dict
+import logging
+from typing import Dict, List, Optional
 
+import numpy as np
+
+from ..resilience import (
+    CircuitBreaker, DegradedFeatureCache, HealthMonitor, RetryPolicy,
+)
 from .dist_context import init_client_context
 from .dist_server import server_port
-from .rpc import RpcClient
+from .rpc import RpcClient, ping_endpoint
+
+logger = logging.getLogger(__name__)
 
 _clients: Dict[int, RpcClient] = {}
 _num_servers = 0
 _client_rank = 0
 _num_clients = 0
+_health: Optional[HealthMonitor] = None
+_metrics = None                         # shared ServingMetrics
+_replicas: Dict[int, List[int]] = {}    # server -> replica servers
+_feat_cache = DegradedFeatureCache()
+_dropouts: set = set()
 
 
 def init_client(num_servers: int, num_clients: int, client_rank: int,
                 master_addr: str = '127.0.0.1',
-                master_port: int = 29500) -> None:
-  global _num_servers, _client_rank, _num_clients
+                master_port: int = 29500,
+                rpc_timeout: float = 180.0,
+                retry: Optional[RetryPolicy] = None,
+                breaker_threshold: int = 5,
+                breaker_reset_s: float = 5.0,
+                health_interval_s: Optional[float] = 1.0) -> None:
+  """``health_interval_s=None`` disables the background prober (passive
+  health from the request path still applies); the other knobs
+  parameterize each per-server RpcClient's retry/breaker stack."""
+  global _num_servers, _client_rank, _num_clients, _health, _metrics, \
+      _feat_cache
+  from ..serving.metrics import ServingMetrics
   init_client_context(num_servers, num_clients, client_rank)
   _num_servers = num_servers
   _client_rank = client_rank
   _num_clients = num_clients
+  _metrics = ServingMetrics()
+  _dropouts.clear()
+  _replicas.clear()
+  # fresh per client session: rows cached against a PREVIOUS session's
+  # dataset must never be served as this session's degraded answers
+  _feat_cache = DegradedFeatureCache()
   for s in range(num_servers):
-    _clients[s] = RpcClient(master_addr, server_port(master_port, s))
+    _clients[s] = RpcClient(
+        master_addr, server_port(master_port, s), timeout=rpc_timeout,
+        retry=retry,
+        breaker=CircuitBreaker(failure_threshold=breaker_threshold,
+                               reset_timeout_s=breaker_reset_s),
+        metrics=_metrics)
+
+  def probe(rank):
+    # single-attempt probe on a FRESH socket (rpc.ping_endpoint): it
+    # must neither hide failure behind the retry budget nor contend on
+    # the shared client's request lock (held for a wedged request's
+    # whole recv — probing THROUGH it would stall the sweep)
+    addr = (master_addr, server_port(master_port, rank))
+    return lambda: ping_endpoint(*addr, timeout=2.0)
+
+  _health = HealthMonitor({s: probe(s) for s in range(num_servers)},
+                          interval_s=health_interval_s or 1.0,
+                          degraded_after=1, down_after=3)
+  if health_interval_s is not None:
+    _health.start()
+
+
+def get_health() -> Optional[HealthMonitor]:
+  return _health
+
+
+def get_metrics():
+  return _metrics
+
+
+def set_replicas(mapping: Dict[int, List[int]]) -> None:
+  """Declare replica servers per partition server: a failed lookup on
+  ``rank`` fails over, in order, to ``mapping[rank]`` (servers loaded
+  with a copy of that partition)."""
+  _replicas.clear()
+  for k, v in mapping.items():
+    _replicas[int(k)] = [int(r) for r in v]
 
 
 def request_server(server_rank: int, method: str, *args, **kwargs):
-  return _clients[server_rank].request(method, *args, **kwargs)
+  try:
+    out = _clients[server_rank].request(method, *args, **kwargs)
+  except (ConnectionError, OSError):
+    if _health is not None:
+      _health.record_failure(server_rank)
+    raise
+  if _health is not None:
+    _health.record_success(server_rank)
+  return out
 
 
 def async_request_server(server_rank: int, method: str, *args, **kwargs):
   return _clients[server_rank].async_request(method, *args, **kwargs)
+
+
+def request_with_failover(server_rank: int, method: str, *args,
+                          **kwargs):
+  """``request_server`` that walks the replica chain on connection
+  failure. Known-DOWN candidates are skipped (fail fast past them)
+  unless they are the last resort — except for an occasional
+  rate-limited probe-through (``HealthMonitor.allow_probe``), so a
+  restarted primary rejoins even when no background prober is running
+  (its passive ``record_success`` is the only recovery signal then)."""
+  chain = [int(server_rank)] + _replicas.get(int(server_rank), [])
+  last: Optional[BaseException] = None
+  for k, rank in enumerate(chain):
+    if (_health is not None and _health.is_down(rank)
+        and k < len(chain) - 1
+        and not _health.allow_probe(rank)):
+      last = last or ConnectionError(f'server {rank} is DOWN')
+      continue
+    try:
+      out = request_server(rank, method, *args, **kwargs)
+    except (ConnectionError, OSError) as e:
+      last = e
+      continue
+    if k > 0 and _metrics is not None:
+      _metrics.record_failover()
+    return out
+  assert last is not None
+  raise last
+
+
+def get_node_feature(server_rank: int, ids, degrade: bool = True
+                    ) -> np.ndarray:
+  """Remote node-feature rows with the full degradation ladder:
+  primary -> replicas (``set_replicas``) -> bounded-staleness cache
+  (recently fetched rows; zero-fill for true misses, both counted in
+  the fabric metrics). ``degrade=False`` stops after the replica tier
+  and re-raises."""
+  from ..channel import pack_message, unpack_message
+  ids = np.asarray(ids, np.int64).reshape(-1)
+  try:
+    out = unpack_message(request_with_failover(
+        server_rank, 'get_node_feature', pack_message({'ids': ids})))
+  except (ConnectionError, OSError) as e:
+    if not degrade:
+      raise
+    return _feat_cache.serve_counted(
+        ids, _metrics, what=f'get_node_feature(server {server_rank})',
+        cause=e)
+  rows = np.asarray(out['feats'])
+  _feat_cache.update(ids, rows)
+  return rows
+
+
+def record_server_dropout(server_rank: int) -> None:
+  """A consumer (loader) gave up on this server for the epoch: fold it
+  into health + metrics so the degradation is observable."""
+  _dropouts.add(int(server_rank))
+  if _health is not None:
+    _health.record_failure(server_rank)
+  if _metrics is not None:
+    _metrics.set_gauge('server_dropouts', float(len(_dropouts)))
+
+
+def fabric_stats() -> dict:
+  """Client-side resilience observability: retry/reconnect/breaker/
+  failover counters, per-server health, degraded-cache occupancy."""
+  return {
+      'metrics': _metrics.snapshot() if _metrics is not None else {},
+      'health': _health.snapshot() if _health is not None else {},
+      'dropouts': sorted(_dropouts),
+      'degraded_cache_rows': len(_feat_cache),
+  }
 
 
 def apply_delta(server_rank: int, ins=None, dels=None, feat_ids=None,
@@ -45,8 +198,6 @@ def apply_delta(server_rank: int, ins=None, dels=None, feat_ids=None,
   ``DistServer.apply_delta``). ``ins``/``dels`` are [2, n] edge blocks
   in that partition's local ids; ``compact=True`` forces the server to
   fold the delta into a fresh snapshot immediately."""
-  import numpy as np
-
   from ..channel import pack_message
   msg = {}
   if ins is not None:
@@ -68,10 +219,18 @@ def barrier() -> None:
 
 
 def shutdown_client() -> None:
-  """Ordered shutdown (reference dist_client.py:57-79)."""
+  """Ordered shutdown (reference dist_client.py:57-79). A dead server
+  must not wedge teardown: the drain barrier is best-effort."""
+  global _health, _metrics
   if not _clients:
     return
-  barrier()
+  if _health is not None:
+    _health.stop()
+  try:
+    barrier()
+  except (ConnectionError, OSError):
+    logger.warning('shutdown barrier failed (dead server?); '
+                   'tearing down anyway')
   if _client_rank == 0:
     for s in range(_num_servers):
       try:
@@ -81,3 +240,5 @@ def shutdown_client() -> None:
   for c in _clients.values():
     c.close()
   _clients.clear()
+  _health = None
+  _dropouts.clear()

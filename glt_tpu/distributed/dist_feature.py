@@ -381,7 +381,9 @@ class DistFeature:
 
   def set_cold_fetcher(self, fetcher) -> None:
     """Register the remote cold-row resolver:
-    ``fetcher(partition: int, ids: np.int64 [M]) -> np [M, D]``."""
+    ``fetcher(partition: int, ids: np.int64 [M]) -> np [M, D]``.
+    Wrap with :func:`resilient_cold_fetcher` for replica failover +
+    bounded-staleness degradation on dead owners."""
     self._cold_fetcher = fetcher
 
   def cold_get(self, partition: int, ids: np.ndarray) -> np.ndarray:
@@ -460,6 +462,52 @@ class DistFeature:
                row_gather=row_gather, hot_counts=hots,
                cold_fetcher=cold_fetcher, bucket_cap=bucket_cap,
                host_offload=host_offload)
+
+
+def resilient_cold_fetcher(fetchers, feature_dim: Optional[int] = None,
+                           metrics=None, cache_capacity: int = 200_000):
+  """Compose per-partition cold fetchers into one fault-tolerant
+  ``fetcher(partition, ids) -> [M, D]`` for
+  :meth:`DistFeature.set_cold_fetcher`.
+
+  Args:
+    fetchers: ``{partition: [fn, ...]}`` — each ``fn(ids) -> [M, D]``,
+      primaries first, replicas after (build the list from
+      ``rpc_sync_data_partitions``: every rank serving a partition is a
+      replica of its rows).
+    feature_dim: row width for zero-fill before any fetch succeeded.
+    metrics: optional ServingMetrics — failovers and stale serves are
+      counted there (the same counters the serving stack uses).
+
+  Ladder per lookup: primary -> replicas in order (each connection
+  failure recorded, first success wins and refreshes the staleness
+  cache) -> cached rows + zero-fill for true misses. Raises only when
+  degradation is impossible (no cache rows AND unknown row width).
+  """
+  from ..resilience import DegradedFeatureCache
+  stale = DegradedFeatureCache(capacity=cache_capacity)
+  if feature_dim is not None:
+    stale.feature_dim = int(feature_dim)
+  fetchers = {int(p): list(fs) for p, fs in fetchers.items()}
+
+  def fetch(partition: int, ids: np.ndarray) -> np.ndarray:
+    chain = fetchers.get(int(partition), [])
+    last: Optional[BaseException] = None
+    for k, fn in enumerate(chain):
+      try:
+        rows = np.asarray(fn(np.asarray(ids, np.int64)))
+      except (ConnectionError, OSError) as e:
+        last = e
+        continue
+      if k > 0 and metrics is not None:
+        metrics.record_failover()
+      stale.update(ids, rows)
+      return rows
+    return stale.serve_counted(
+        ids, metrics, what=f'cold fetch(partition {partition})',
+        cause=last)
+
+  return fetch
 
 
 def dist_feature_from_partitions_multihost(mesh, root_dir: str,

@@ -14,6 +14,14 @@ dist_neighbor_loader.py. The reference's three deployment modes map as:
 Each iteration yields a *stacked* per-device batch dict ([P, ...] arrays,
 shard-major) plus per-device validity — the shape DistTrainStep and DDP
 consumers expect.
+
+Fault tolerance: this collective loader's data plane is XLA all2all
+(no sockets to fail independently — a lost mesh process is a
+whole-program fault handled by the launcher). The rpc-fed loaders are
+where graceful degradation lives: RemoteNeighborLoader drops a dead
+server from the epoch instead of stalling (channel_loader.py), and
+DistFeature cold fetchers fail over / degrade via
+``resilient_cold_fetcher`` — see docs/fault_tolerance.md.
 """
 from __future__ import annotations
 

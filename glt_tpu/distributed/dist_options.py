@@ -38,8 +38,17 @@ class MpDistSamplingWorkerOptions(_BasicDistSamplingWorkerOptions):
 
 @dataclasses.dataclass
 class RemoteDistSamplingWorkerOptions(_BasicDistSamplingWorkerOptions):
-  """Reference dist_options.py:210-292."""
+  """Reference dist_options.py:210-292.
+
+  ``degrade_on_server_failure``: when a server's connection is lost
+  past the rpc retry budget (or its circuit is open), the loader logs
+  the dropout, records it in the fabric metrics/health, and finishes
+  the epoch with the surviving servers instead of raising — the
+  degradation tier docs/fault_tolerance.md documents. Set False for
+  the legacy fail-stop behavior (the error propagates out of
+  ``recv``)."""
   server_rank: Union[int, List[int], None] = None
   buffer_capacity_bytes: int = 256 * 1024 * 1024
   prefetch_size: int = 4
   worker_key: str = 'default'
+  degrade_on_server_failure: bool = True

@@ -50,6 +50,16 @@ class DistServer:
 
   # -- control plane -----------------------------------------------------
 
+  def ping(self) -> dict:
+    """Liveness + readiness probe (HealthMonitor target; richer than
+    the rpc fabric's built-in ``_ping``)."""
+    return {
+        'ok': True,
+        'exiting': self._exit.is_set(),
+        'producers': len(self._producers),
+        'partition_idx': getattr(self.dataset, 'partition_idx', 0),
+    }
+
   def get_dataset_meta(self):
     ds = self.dataset
     num_nodes = (None if ds.is_hetero else ds.get_graph().num_nodes)
@@ -286,7 +296,7 @@ def init_server(num_servers: int, num_clients: int, server_rank: int,
                'start_new_epoch_sampling', 'fetch_one_sampled_message',
                'get_node_feature', 'get_node_label', 'get_tensor_size',
                'get_edge_index', 'get_edge_size',
-               'get_node_partition_id', 'apply_delta', 'exit'):
+               'get_node_partition_id', 'apply_delta', 'exit', 'ping'):
     _rpc_server.register(name, getattr(_server, name))
   _rpc_server.start()  # accept only after all callees exist
   return _server

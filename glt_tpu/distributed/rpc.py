@@ -12,14 +12,35 @@ bytes, not pickled arrays.
 """
 from __future__ import annotations
 
+import itertools
+import os
 import pickle
 import socket
 import struct
 import threading
+import time
+from collections import OrderedDict
 from concurrent.futures import Future, ThreadPoolExecutor
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, FrozenSet, List, Optional
+
+from ..resilience.retry import (
+    CircuitBreaker, CircuitOpenError, RetryPolicy,
+)
 
 _HDR = struct.Struct('<Q')
+
+#: Callees safe to retry after a lost reply (read-only, or — like
+#: fetch_one_sampled_message — made retry-safe by the server's
+#: request-id dedup cache, which replays the original reply instead of
+#: re-executing a pop). Mutating callees (apply_delta, exit, barriers)
+#: are deliberately absent: they get transparent reconnect but never an
+#: automatic re-send after the request may have been delivered.
+IDEMPOTENT_CALLEES: FrozenSet[str] = frozenset({
+    'get_node_feature', 'get_node_label', 'get_dataset_meta',
+    'get_tensor_size', 'get_edge_index', 'get_edge_size',
+    'get_node_partition_id', 'fetch_one_sampled_message',
+    'infer', 'stats', 'ping', '_ping',
+})
 
 
 def _send_msg(sock: socket.socket, obj: Any) -> None:
@@ -63,18 +84,48 @@ class RpcServer:
                                                else 1.0))
     self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    try:
+      # a bounced server must rebind its well-known port immediately:
+      # some kernels keep TIME_WAIT pairs blocking plain SO_REUSEADDR
+      # binds for minutes after the old process's conns drained
+      self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    except (AttributeError, OSError):
+      pass
     self._sock.bind((host, port))
     self._sock.listen(64)
     self.host, self.port = self._sock.getsockname()
     self._callees: Dict[str, Callable] = {}
     self._threads: List[threading.Thread] = []
+    self._conns: List[socket.socket] = []
     self._stop = threading.Event()
     self._barriers: Dict[str, threading.Barrier] = {}
     self._gathers: Dict[str, dict] = {}
     self._lock = threading.Lock()
     self._reg_cond = threading.Condition(self._lock)
+    # request-id dedup (at-least-once -> exactly-once-observable): a
+    # retried idempotent request whose ORIGINAL attempt executed but
+    # whose reply was lost gets the cached reply replayed instead of a
+    # second execution — this is what makes fetch_one_sampled_message
+    # (a queue pop) safe to retry
+    # bounded two ways: entries can hold whole sampled-batch payloads,
+    # so (a) a NEW request arriving on a connection proves the client
+    # consumed the previous reply on it (requests are strictly serial
+    # per connection; retries always redial) — the previous entry is
+    # evicted immediately, bounding steady state to ~1 entry per live
+    # connection — and (b) the LRU cap is the backstop for entries
+    # orphaned by dropped connections
+    self._dedup: 'OrderedDict[str, tuple]' = OrderedDict()
+    self._dedup_cap = 256
+    # req_id -> Event for requests currently EXECUTING: a retry that
+    # lands while the original attempt is still running (client recv
+    # timeout below the callee's legitimate block time) must WAIT for
+    # that execution and replay its reply — re-executing concurrently
+    # would double-pop fetch_one_sampled_message and lose a batch
+    self._dedup_inflight: Dict[str, threading.Event] = {}
+    self.dedup_hits = 0
     self.register('_barrier', self._barrier)
     self.register('_gather', self._gather)
+    self.register('_ping', self._ping)
     self._accept_thread = None
     if auto_start:
       self.start()
@@ -118,6 +169,12 @@ class RpcServer:
             raise KeyError(name)
       return self._callees[name]
 
+  def _ping(self) -> dict:
+    """Built-in liveness probe every endpoint answers (HealthMonitor
+    targets this; servers may also register a richer 'ping')."""
+    with self._lock:
+      return {'ok': True, 'callees': len(self._callees)}
+
   # built-in synchronization callees (reference rpc.py:105-235)
   def _barrier(self, key: str, world: int) -> bool:
     with self._lock:
@@ -154,24 +211,113 @@ class RpcServer:
         break
       t = threading.Thread(target=self._serve_conn, args=(conn,),
                            daemon=True)
+      with self._lock:
+        self._conns.append(conn)
+        self._threads.append(t)
       t.start()
-      self._threads.append(t)
+
+  def _dedup_get(self, req_id: Optional[str]):
+    """Cached reply for ``req_id``, WAITING out an in-flight original
+    execution first (so a duplicate never executes concurrently).
+    Returns None only when this thread should execute the request."""
+    if req_id is None:
+      return None
+    while True:
+      with self._lock:
+        hit = self._dedup.get(req_id)
+        if hit is not None:
+          self.dedup_hits += 1
+          self._dedup.move_to_end(req_id)
+          return hit
+        ev = self._dedup_inflight.get(req_id)
+        if ev is None:
+          self._dedup_inflight[req_id] = threading.Event()
+          return None
+      # another connection is executing this very request: wait for it,
+      # then loop — the re-check either replays its reply or (executor
+      # vanished without one) atomically claims execution
+      if not ev.wait(timeout=300):
+        with self._lock:
+          if self._dedup_inflight.get(req_id) is ev:
+            # executor presumed dead after the full wait: claim it
+            self._dedup_inflight[req_id] = threading.Event()
+            return None
+
+  def _dedup_put(self, req_id: Optional[str], reply) -> None:
+    if req_id is None:
+      return
+    with self._lock:
+      if reply is not None:
+        self._dedup[req_id] = reply
+        self._dedup.move_to_end(req_id)
+        while len(self._dedup) > self._dedup_cap:
+          self._dedup.popitem(last=False)
+      ev = self._dedup_inflight.pop(req_id, None)
+    if ev is not None:
+      ev.set()
 
   def _serve_conn(self, conn: socket.socket) -> None:
-    with conn:
-      while not self._stop.is_set():
+    try:
+      with conn:
+        self._serve_conn_loop(conn)
+    finally:
+      # prune: reconnect-heavy clients (the hardened RpcClient redials
+      # on every recovery) would otherwise grow _conns — and the dead
+      # per-connection Thread objects — without bound
+      me = threading.current_thread()
+      with self._lock:
         try:
-          name, args, kwargs = _recv_msg(conn)
-        except (ConnectionError, EOFError, OSError):
+          self._conns.remove(conn)
+        except ValueError:
+          pass
+        try:
+          self._threads.remove(me)
+        except ValueError:
+          pass
+
+  def _serve_conn_loop(self, conn: socket.socket) -> None:
+    prev_req_id: Optional[str] = None
+    while not self._stop.is_set():
+      try:
+        msg = _recv_msg(conn)
+      except (ConnectionError, EOFError, OSError):
+        return
+      # wire format: (name, args, kwargs[, req_id]) — the 4th element
+      # rides only on retryable requests
+      name, args, kwargs = msg[0], msg[1], msg[2]
+      req_id = msg[3] if len(msg) > 3 else None
+      # any subsequent request on this connection proves the client
+      # consumed the previous reply (serial per connection; a retry
+      # after a drop redials) — release the cached payload now instead
+      # of pinning up to _dedup_cap full batch replies in steady state
+      if prev_req_id is not None and prev_req_id != req_id:
+        with self._lock:
+          self._dedup.pop(prev_req_id, None)
+      if req_id is not None:
+        prev_req_id = req_id
+      cached = self._dedup_get(req_id)
+      if cached is not None:
+        try:
+          _send_msg(conn, cached)
+        except (ConnectionError, OSError):
           return
+        continue
+      try:
+        fn = self._resolve(name)
+        reply = ('ok', fn(*args, **kwargs))
+      except BaseException as e:  # deliver errors to the caller
         try:
-          fn = self._resolve(name)
-          _send_msg(conn, ('ok', fn(*args, **kwargs)))
-        except BaseException as e:  # deliver errors to the caller
-          try:
-            _send_msg(conn, ('err', e))
-          except Exception:
-            _send_msg(conn, ('err', RuntimeError(str(e))))
+          pickle.dumps(e)
+          reply = ('err', e)
+        except Exception:
+          reply = ('err', RuntimeError(str(e)))
+      # callee errors are cached too: a retried request must observe
+      # the SAME outcome as the lost original, success or not
+      self._dedup_put(req_id, reply)
+      try:
+        _send_msg(conn, reply)
+      except (ConnectionError, OSError):
+        return
 
   def stop(self) -> None:
     self._stop.set()
@@ -179,56 +325,265 @@ class RpcServer:
       self._sock.close()
     except OSError:
       pass
+    # close live per-connection sockets too: serve threads unblock and
+    # exit, and the port is immediately rebindable (a bounced server
+    # can come back on the same address — the reconnect story depends
+    # on it)
+    with self._lock:
+      conns, self._conns = self._conns, []
+    for c in conns:
+      try:
+        c.close()
+      except OSError:
+        pass
+
+
+def ping_endpoint(host: str, port: int, timeout: float = 2.0) -> dict:
+  """One-shot liveness probe on a FRESH connection: connect, call the
+  built-in ``_ping``, close. Health probers use this instead of a
+  shared RpcClient so a wedged in-flight request (which holds the
+  client's lock for its whole recv) can never stall health detection
+  for the other peers."""
+  sock = socket.create_connection((host, int(port)), timeout=timeout)
+  try:
+    sock.settimeout(timeout)
+    _send_msg(sock, ('_ping', (), {}))
+    status, payload = _recv_msg(sock)
+  finally:
+    try:
+      sock.close()
+    except OSError:
+      pass
+  if status == 'err':
+    raise payload
+  return payload
+
+
+#: process-unique prefix for request ids (pid guards against forked
+#: twins colliding in one server's dedup cache)
+_CLIENT_IDS = itertools.count()
 
 
 class RpcClient:
   """One connection per (client, server); thread-safe; async via a pool
-  (the reference's async_request_server, dist_client.py:82-101)."""
+  (the reference's async_request_server, dist_client.py:82-101).
+
+  Hardened (docs/fault_tolerance.md):
+
+    * **transparent reconnect** — a peer close no longer kills the
+      client; the dead socket is dropped and the next request redials;
+    * **per-request deadlines** — ``_rpc_timeout`` bounds one request's
+      recv instead of the connection-wide 180 s default;
+    * **idempotent retry** — requests to :data:`IDEMPOTENT_CALLEES`
+      (plus ``idempotent`` extras) carry a request id and are retried
+      under ``retry`` (capped exponential backoff + jitter); the
+      server's dedup cache replays a lost reply rather than
+      re-executing. Send-phase failures (the request provably never
+      left) are retried for EVERY callee;
+    * **circuit breaker** — ``failure_threshold`` consecutive
+      connection errors trip the per-peer breaker and subsequent calls
+      fail fast with :class:`CircuitOpenError` until the reset timeout
+      admits a probe, instead of each eating a full timeout.
+
+  ``metrics`` (any object with record_retry / record_reconnect /
+  record_breaker_open, e.g. ServingMetrics) observes recovery actions;
+  the client also keeps local ``retries`` / ``reconnects`` counters.
+  """
 
   _pool = ThreadPoolExecutor(max_workers=16)
 
   def __init__(self, host: str, port: int, timeout: float = 180.0,
-               connect_retries: int = 60, retry_interval: float = 0.5):
+               connect_retries: int = 60, retry_interval: float = 0.5,
+               retry: Optional[RetryPolicy] = None,
+               breaker: Optional[CircuitBreaker] = None,
+               idempotent: Optional[FrozenSet[str]] = None,
+               metrics=None):
     self._addr = (host, port)
     self._timeout = timeout
     self._lock = threading.Lock()
     self._sock = None
+    self._retry = retry or RetryPolicy()
+    self._idempotent = IDEMPOTENT_CALLEES | frozenset(idempotent or ())
+    self.metrics = metrics
+    self.breaker = breaker or CircuitBreaker()
+    if self.breaker.on_open is None:
+      self.breaker.on_open = self._on_breaker_open
+    self.retries = 0
+    self.reconnects = 0
+    self._req_prefix = f'{os.getpid()}.{next(_CLIENT_IDS)}'
+    self._req_seq = itertools.count()
     self._connect(connect_retries, retry_interval)
 
-  def _connect(self, retries: int = 1, interval: float = 0.5) -> None:
+  def _on_breaker_open(self) -> None:
+    if self.metrics is not None:
+      self.metrics.record_breaker_open()
+
+  def _connect(self, retries: int = 1, interval: float = 0.5,
+               timeout: Optional[float] = None) -> None:
     # peers race at startup (the reference retries rendezvous the same
-    # way, rpc.py:280-322 MAX_RETRY 60 @ 3s)
-    import time as _time
+    # way, rpc.py:280-322 MAX_RETRY 60 @ 3s). ``timeout`` caps ONE
+    # connect attempt; deadline-bounded requests pass their remaining
+    # budget so a SYN-blackholed peer can't hold them for the full
+    # connection-wide timeout.
     last = None
-    for _ in range(max(retries, 1)):
+    tries = max(retries, 1)
+    connect_timeout = self._timeout if timeout is None \
+        else min(self._timeout, timeout)
+    for k in range(tries):
       try:
         self._sock = socket.create_connection(self._addr,
-                                              timeout=self._timeout)
+                                              timeout=connect_timeout)
         return
       except OSError as e:
         last = e
-        _time.sleep(interval)
+        if k + 1 < tries:  # no pointless sleep after the final attempt
+          time.sleep(interval)
     raise ConnectionError(
         f'could not connect to {self._addr}: {last}')
 
-  def request(self, name: str, *args, **kwargs):
+  def _drop_sock_locked(self) -> None:
+    if self._sock is not None:
+      try:
+        self._sock.close()
+      except OSError:
+        pass
+      self._sock = None
+
+  def _request_once(self, name: str, args, kwargs,
+                    req_id: Optional[str],
+                    rpc_timeout: Optional[float]):
+    """One attempt over the (re)established socket. Raises
+    ``_SendPhaseError`` when the failure provably predates delivery
+    (safe to retry for any callee)."""
     with self._lock:
-      _send_msg(self._sock, (name, args, kwargs))
-      status, payload = _recv_msg(self._sock)
+      if self._sock is None:
+        try:
+          self._connect(retries=1, timeout=rpc_timeout)
+        except ConnectionError as e:
+          raise _SendPhaseError(e) from e
+        self.reconnects += 1
+        if self.metrics is not None:
+          self.metrics.record_reconnect()
+      msg = ((name, args, kwargs, req_id) if req_id is not None
+             else (name, args, kwargs))
+      try:
+        _send_msg(self._sock, msg)
+      except (ConnectionError, OSError) as e:
+        self._drop_sock_locked()
+        raise _SendPhaseError(e) from e
+      try:
+        if rpc_timeout is not None:
+          self._sock.settimeout(rpc_timeout)
+        try:
+          status, payload = _recv_msg(self._sock)
+        finally:
+          if rpc_timeout is not None and self._sock is not None:
+            self._sock.settimeout(self._timeout)
+      except (ConnectionError, EOFError, OSError,
+              pickle.UnpicklingError):
+        # the reply is unrecoverable on this connection either way —
+        # a stray late reply on a reused socket would answer the WRONG
+        # request
+        self._drop_sock_locked()
+        raise
     if status == 'err':
-      raise payload
+      # wrapped so a callee-raised ConnectionError is never mistaken
+      # for a transport failure (which would wrongly trip the breaker
+      # and burn retry attempts replaying the same cached error)
+      raise _CalleeError(payload)
     return payload
+
+  def request(self, name: str, *args, _rpc_timeout: Optional[float]
+              = None, **kwargs):
+    """Call ``name`` on the peer. ``_rpc_timeout`` (seconds) is this
+    request's TOTAL reply budget across every retry (reserved kwarg —
+    never forwarded to the callee): each attempt's recv gets the
+    remaining slice, and the retry loop stops once the budget is spent
+    — a wedged peer cannot hold the caller for attempts x timeout.
+    Connection errors engage reconnect/retry/breaker as described on
+    the class."""
+    retryable = name in self._idempotent
+    attempts = self._retry.max_attempts
+    req_id = (f'{self._req_prefix}.{next(self._req_seq)}'
+              if retryable else None)
+    deadline = (time.monotonic() + _rpc_timeout
+                if _rpc_timeout is not None else None)
+    last: Optional[BaseException] = None
+    for attempt in range(attempts):
+      if not self.breaker.allow():
+        raise CircuitOpenError(
+            f'circuit open for peer {self._addr} '
+            f'(after {self.breaker.failure_threshold} consecutive '
+            'failures); failing fast')
+      budget = None
+      if deadline is not None:
+        # slice the remaining budget over the remaining attempts: a
+        # dropped reply must leave room to retry, yet the attempts can
+        # never sum past the caller's deadline
+        remaining = max(deadline - time.monotonic(), 0.001)
+        budget = remaining / (attempts - attempt) if retryable \
+            else remaining
+      try:
+        out = self._request_once(name, args, kwargs, req_id, budget)
+      except _CalleeError as e:
+        # callee-raised error: delivered + executed — the peer is
+        # healthy, so neither the breaker nor the retry loop applies
+        self.breaker.record_success()
+        raise e.error
+      except _SendPhaseError as e:
+        # request never delivered: retry is safe for ANY callee
+        self.breaker.record_failure()
+        last = e.cause
+      except (ConnectionError, EOFError, OSError,
+              pickle.UnpicklingError) as e:
+        self.breaker.record_failure()
+        if not retryable:
+          raise
+        last = e
+      except BaseException:
+        # anything else (an unpicklable argument, a caller bug) never
+        # exercised the peer: hand back a HALF_OPEN probe token taken
+        # by allow() — without this the breaker wedges OPEN forever
+        self.breaker.release_probe()
+        raise
+      else:
+        self.breaker.record_success()
+        return out
+      if deadline is not None and time.monotonic() >= deadline:
+        break  # budget spent: no further attempts
+      if attempt + 1 < attempts:
+        self.retries += 1
+        if self.metrics is not None:
+          self.metrics.record_retry()
+        self._retry.sleep(attempt)
+    assert last is not None
+    raise last
 
   def async_request(self, name: str, *args, **kwargs) -> Future:
     return self._pool.submit(self.request, name, *args, **kwargs)
 
   def close(self) -> None:
     with self._lock:
-      if self._sock is not None:
-        try:
-          self._sock.close()
-        finally:
-          self._sock = None
+      self._drop_sock_locked()
+
+
+class _SendPhaseError(Exception):
+  """Internal: a connection failure that provably happened before the
+  request could reach the peer (connect refused / send reset), so a
+  retry cannot double-execute even a mutating callee."""
+
+  def __init__(self, cause: BaseException):
+    super().__init__(str(cause))
+    self.cause = cause
+
+
+class _CalleeError(Exception):
+  """Internal: the peer answered with an error the CALLEE raised — a
+  healthy-peer outcome that must reach the caller verbatim."""
+
+  def __init__(self, error: BaseException):
+    super().__init__(str(error))
+    self.error = error
 
 
 # ---------------------------------------------------------------------------

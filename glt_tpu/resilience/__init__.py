@@ -1,0 +1,26 @@
+"""Fault-tolerance fabric: retry/backoff, circuit breaking, health
+monitoring, degradation tiers, and deterministic chaos injection.
+
+The failure model, retry/idempotency contract, and degradation tiers
+are documented in docs/fault_tolerance.md. Everything here is host-side
+control-plane code — none of it touches traced/jitted programs, so the
+zero-steady-state-recompile guarantees of the serving and stream layers
+are preserved by construction.
+"""
+from .chaos import (  # noqa: F401
+    ChaosChannel, ChaosTcpProxy, FaultPlan, chaos_seed, flaky,
+)
+from .health import (  # noqa: F401
+    DEGRADED, DOWN, UP, DegradedFeatureCache, HealthMonitor,
+)
+from .retry import (  # noqa: F401
+    CLOSED, HALF_OPEN, OPEN, CircuitBreaker, CircuitOpenError,
+    RetryPolicy,
+)
+
+__all__ = [
+    'ChaosChannel', 'ChaosTcpProxy', 'FaultPlan', 'chaos_seed', 'flaky',
+    'DegradedFeatureCache', 'HealthMonitor', 'UP', 'DEGRADED', 'DOWN',
+    'CircuitBreaker', 'CircuitOpenError', 'RetryPolicy',
+    'CLOSED', 'OPEN', 'HALF_OPEN',
+]

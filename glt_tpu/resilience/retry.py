@@ -1,0 +1,165 @@
+"""Retry policy + per-peer circuit breaker — the two host-side
+primitives the fault-tolerant fabric is built from.
+
+Failure model (docs/fault_tolerance.md): peers are fail-stop processes
+behind lossy links. A transient fault (dropped frame, flaky link, peer
+restart) is survived by a bounded *retry with capped exponential
+backoff + jitter*; a persistent fault (dead peer) must FAIL FAST — the
+:class:`CircuitBreaker` turns the N-th consecutive connection error
+into an immediate :class:`CircuitOpenError` instead of letting every
+caller eat a full connect/recv timeout against a corpse.
+
+Both primitives are deliberately transport-agnostic host-side objects:
+they never touch traced code, so wiring them through the serving and
+distributed layers preserves the zero-steady-state-recompile guarantee.
+"""
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+#: breaker states (the classic 3-state machine)
+CLOSED = 'CLOSED'
+OPEN = 'OPEN'
+HALF_OPEN = 'HALF_OPEN'
+
+
+class CircuitOpenError(ConnectionError):
+  """Fail-fast rejection: the peer's breaker is OPEN. Subclasses
+  ConnectionError so existing connection-failure handling (failover,
+  epoch degradation) treats a breaker rejection exactly like the dead
+  peer it stands in for."""
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+  """Capped exponential backoff with full jitter.
+
+  delay(attempt) = uniform(min_fraction, 1) * min(base * 2^attempt, cap)
+
+  Args:
+    max_attempts: total tries (1 = no retry).
+    base_delay_s: backoff base for attempt 0.
+    max_delay_s: cap on the un-jittered delay.
+    jitter: fraction of the delay that is randomized; 0 = deterministic
+      (chaos tests pin schedules), 1 = classic full jitter.
+  """
+  max_attempts: int = 4
+  base_delay_s: float = 0.05
+  max_delay_s: float = 2.0
+  jitter: float = 0.5
+
+  def delay(self, attempt: int, rng: Optional[random.Random] = None
+            ) -> float:
+    d = min(self.base_delay_s * (2.0 ** max(attempt, 0)),
+            self.max_delay_s)
+    if self.jitter <= 0:
+      return d
+    r = (rng or random).uniform(1.0 - self.jitter, 1.0)
+    return d * r
+
+  def sleep(self, attempt: int,
+            rng: Optional[random.Random] = None) -> float:
+    d = self.delay(attempt, rng)
+    if d > 0:
+      time.sleep(d)
+    return d
+
+
+class CircuitBreaker:
+  """Per-peer CLOSED -> OPEN -> HALF_OPEN breaker.
+
+  CLOSED: requests flow; ``failure_threshold`` CONSECUTIVE failures
+  trip it OPEN (a single success resets the streak — an occasionally
+  flaky peer never trips).
+  OPEN: ``allow()`` is False (callers raise CircuitOpenError without
+  touching the socket) until ``reset_timeout_s`` elapses, then one
+  probe is admitted (HALF_OPEN).
+  HALF_OPEN: exactly one in-flight probe; its success closes the
+  breaker, its failure re-opens (and re-arms the timeout).
+
+  Thread-safe; all transitions happen under one lock. ``on_open`` is
+  called (outside the lock) every CLOSED/HALF_OPEN -> OPEN transition —
+  the metrics hook.
+  """
+
+  def __init__(self, failure_threshold: int = 5,
+               reset_timeout_s: float = 5.0,
+               on_open: Optional[Callable[[], None]] = None):
+    assert failure_threshold >= 1
+    self.failure_threshold = int(failure_threshold)
+    self.reset_timeout_s = float(reset_timeout_s)
+    self.on_open = on_open
+    self._lock = threading.Lock()
+    self._state = CLOSED
+    self._consecutive_failures = 0
+    self._opened_at = 0.0
+    self._probe_inflight = False
+    self.opens = 0  # lifetime OPEN transitions (metrics)
+
+  @property
+  def state(self) -> str:
+    with self._lock:
+      return self._state_locked()
+
+  def _state_locked(self) -> str:
+    if (self._state == OPEN and not self._probe_inflight
+        and time.monotonic() - self._opened_at >= self.reset_timeout_s):
+      return HALF_OPEN
+    return self._state
+
+  def allow(self) -> bool:
+    """True if a request may proceed. In HALF_OPEN this ADMITS the one
+    probe (side effect: the token is taken until record_*)."""
+    with self._lock:
+      s = self._state_locked()
+      if s == CLOSED:
+        return True
+      if s == HALF_OPEN and not self._probe_inflight:
+        self._probe_inflight = True
+        return True
+      return False
+
+  def record_success(self) -> None:
+    with self._lock:
+      self._state = CLOSED
+      self._consecutive_failures = 0
+      self._probe_inflight = False
+
+  def record_failure(self) -> None:
+    fire = False
+    with self._lock:
+      self._consecutive_failures += 1
+      if self._probe_inflight:  # failed HALF_OPEN probe: re-open
+        self._probe_inflight = False
+        self._state = OPEN
+        self._opened_at = time.monotonic()
+        self.opens += 1
+        fire = True
+      elif (self._state == CLOSED
+            and self._consecutive_failures >= self.failure_threshold):
+        self._state = OPEN
+        self._opened_at = time.monotonic()
+        self.opens += 1
+        fire = True
+    if fire and self.on_open is not None:
+      try:
+        self.on_open()
+      except Exception:
+        pass
+
+  def release_probe(self) -> None:
+    """Return a HALF_OPEN probe token taken by ``allow()`` when the
+    attempt aborted before the peer was ever exercised (an unpicklable
+    argument, a caller bug) — neither a success nor a peer failure, so
+    the token must come back or the breaker wedges OPEN forever with
+    no probe ever admitted again."""
+    with self._lock:
+      self._probe_inflight = False
+
+  def reset(self) -> None:
+    """Force-close (admin/testing hook)."""
+    self.record_success()

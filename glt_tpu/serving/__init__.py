@@ -12,14 +12,17 @@ The request path is::
 See docs/serving.md for architecture, bucket tuning, and cache
 invalidation semantics.
 """
-from .batcher import MicroBatcher, ServingOverloaded  # noqa: F401
+from .batcher import (  # noqa: F401
+    EngineStalledError, MicroBatcher, ServingOverloaded,
+)
 from .embedding_cache import EmbeddingCache  # noqa: F401
 from .engine import InferenceEngine  # noqa: F401
 from .metrics import LatencyHistogram, ServingMetrics  # noqa: F401
 from .server import ServingClient, ServingServer  # noqa: F401
 
 __all__ = [
-    'MicroBatcher', 'ServingOverloaded', 'EmbeddingCache',
+    'MicroBatcher', 'ServingOverloaded', 'EngineStalledError',
+    'EmbeddingCache',
     'InferenceEngine', 'LatencyHistogram', 'ServingMetrics',
     'ServingClient', 'ServingServer',
 ]

@@ -77,6 +77,24 @@ class EmbeddingCache:
           out[int(i)] = row
     return out
 
+  def lookup_stale(self, ids: Iterable[int]) -> dict:
+    """Degraded-mode read: {node_id: row} probing EVERY live version,
+    newest first — the stale-serve tier answers from whatever the cache
+    still holds while the engine circuit is open. Counts neither hits
+    nor misses (a disaster-mode read must not skew the steady-state
+    hit-rate the capacity tuning watches) and does not touch LRU order
+    (stale reads must not keep stale entries artificially hot)."""
+    out = {}
+    with self._lock:
+      versions = sorted(self._version_counts, reverse=True)
+      for i in ids:
+        for v in versions:
+          row = self._data.get((int(i), v))
+          if row is not None:
+            out[int(i)] = row
+            break
+    return out
+
   def insert(self, ids: Iterable[int], values: np.ndarray,
              version: int) -> None:
     if self.capacity <= 0:

@@ -226,6 +226,34 @@ class InferenceEngine:
           local[int(i)] = row
       return np.stack([local[int(i)] for i in ids_np])
 
+  def stale_serve(self, ids):
+    """Degradation tier: answer from the versioned EmbeddingCache ONLY
+    (any live version, newest first), zero-filling true misses —
+    never touches the sampler or the forward, and deliberately does
+    NOT take the engine lock (the lock is exactly what a wedged infer
+    is sitting on). Returns ``(rows [n, D], cached_mask [n])`` so the
+    caller can count stale serves vs zero-fills.
+
+    Raises RuntimeError when the output width is unknown (the engine
+    never completed a forward) — there is nothing to degrade TO."""
+    ids_np = as_numpy(ids).astype(np.int64).reshape(-1)
+    found = self.cache.lookup_stale(ids_np)
+    dim = self._out_dim
+    if dim is None and found:
+      dim = int(next(iter(found.values())).shape[0])
+    if dim is None:
+      raise RuntimeError(
+          'stale_serve before any completed forward: output dim '
+          'unknown and the cache is empty')
+    out = np.zeros((ids_np.size, dim), np.float32)
+    mask = np.zeros(ids_np.size, bool)
+    for k, i in enumerate(ids_np.tolist()):
+      row = found.get(int(i))
+      if row is not None:
+        out[k] = row
+        mask[k] = True
+    return out, mask
+
   # -- invalidation hooks ------------------------------------------------
 
   def set_params(self, params, bump_version: bool = True) -> int:

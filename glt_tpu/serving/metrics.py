@@ -82,6 +82,15 @@ class ServingMetrics:
     self.batches = 0
     self.batched_ids = 0
     self.batch_capacity = 0
+    # failure/degradation counters (resilience fabric): every degraded
+    # answer and every recovery action is accounted here so a chaos run
+    # can assert that shed + served == submitted, nothing silently lost
+    self.retries = 0          # rpc attempts beyond the first
+    self.reconnects = 0       # transparent socket re-establishments
+    self.breaker_opens = 0    # CLOSED/HALF_OPEN -> OPEN transitions
+    self.shed = 0             # requests dropped BEFORE dispatch (deadline)
+    self.stale_serves = 0     # answers served from cache in degraded mode
+    self.failovers = 0        # lookups redirected to a replica partition
     # gauges: last-value-wins instruments for state (vs the monotonic
     # counters above) — snapshot version, delta occupancy, compaction
     # latency... The stream ingestor publishes here so serving and
@@ -109,9 +118,41 @@ class ServingMetrics:
     with self._lock:
       self.rejected += 1
 
+  def record_retry(self, n: int = 1) -> None:
+    with self._lock:
+      self.retries += int(n)
+
+  def record_reconnect(self) -> None:
+    with self._lock:
+      self.reconnects += 1
+
+  def record_breaker_open(self) -> None:
+    with self._lock:
+      self.breaker_opens += 1
+
+  def record_shed(self, n: int = 1) -> None:
+    with self._lock:
+      self.shed += int(n)
+
+  def record_stale_serve(self, n: int = 1) -> None:
+    with self._lock:
+      self.stale_serves += int(n)
+
+  def record_failover(self, n: int = 1) -> None:
+    with self._lock:
+      self.failovers += int(n)
+
   def set_gauge(self, name: str, value: float) -> None:
     with self._lock:
       self._gauges[str(name)] = float(value)
+
+  def add_gauge(self, name: str, delta: float) -> float:
+    """Atomic accumulate into a gauge (one lock hold — a
+    get_gauge/set_gauge pair would tear under concurrent writers)."""
+    with self._lock:
+      v = self._gauges.get(str(name), 0.0) + float(delta)
+      self._gauges[str(name)] = v
+      return v
 
   def get_gauge(self, name: str, default: float = 0.0) -> float:
     with self._lock:
@@ -146,6 +187,15 @@ class ServingMetrics:
           'batch_fill_ratio': self.batch_fill_ratio,
           'timeouts': self.timeouts,
           'rejected': self.rejected,
+          # resilience counters: snapshotted under the SAME lock hold
+          # as everything above — a reader can never see a torn pair
+          # (e.g. a shed counted but its retry not yet) across fields
+          'retries': self.retries,
+          'reconnects': self.reconnects,
+          'breaker_opens': self.breaker_opens,
+          'shed': self.shed,
+          'stale_serves': self.stale_serves,
+          'failovers': self.failovers,
           'gauges': dict(self._gauges),
       }
     if cache is not None:

@@ -21,7 +21,7 @@ import numpy as np
 
 from ..distributed.rpc import RpcClient, RpcServer
 from ..utils.profile import Timer
-from .batcher import MicroBatcher
+from .batcher import EngineStalledError, MicroBatcher
 from .engine import InferenceEngine
 from .metrics import ServingMetrics
 
@@ -37,14 +37,24 @@ class ServingServer:
     max_batch_size: micro-batch id capacity; defaults to the engine's
       largest bucket (a full micro-batch exactly fills one forward).
     max_wait_ms / max_queue / request_timeout_ms: MicroBatcher knobs.
+    stall_timeout_ms: engine watchdog budget (MicroBatcher) — a
+      dispatch running past it opens the engine circuit and fails all
+      pending futures immediately. None disables the watchdog.
+    stale_serve: while the engine circuit is OPEN, answer infer
+      requests from the versioned EmbeddingCache (zero-fill for
+      misses, stale_serves counted) instead of failing fast — the
+      opt-in availability-over-freshness tier.
   """
 
   def __init__(self, engine: InferenceEngine, host: str = '127.0.0.1',
                port: int = 0, max_batch_size: Optional[int] = None,
                max_wait_ms: float = 2.0, max_queue: int = 1024,
                request_timeout_ms: Optional[float] = 1000.0,
-               warmup: bool = True):
+               warmup: bool = True,
+               stall_timeout_ms: Optional[float] = None,
+               stale_serve: bool = False):
     self.engine = engine
+    self.stale_serve = bool(stale_serve)
     if warmup:
       engine.warmup()
     # metrics clock starts AFTER warmup: bucket compilation (tens of
@@ -54,7 +64,8 @@ class ServingServer:
         engine.infer,
         max_batch_size=max_batch_size or engine.buckets[-1],
         max_wait_ms=max_wait_ms, max_queue=max_queue,
-        request_timeout_ms=request_timeout_ms, metrics=self.metrics)
+        request_timeout_ms=request_timeout_ms, metrics=self.metrics,
+        stall_timeout_ms=stall_timeout_ms)
     self._request_timeout_ms = request_timeout_ms
     # register BEFORE start(): a pre-registered server fails unknown
     # names fast instead of stalling the connection (rpc.RpcServer)
@@ -76,18 +87,34 @@ class ServingServer:
     # validate BEFORE batching: a bad id raised inside the dispatcher
     # would fail every co-batched request, not just this caller's
     self.engine.validate_ids(np.asarray(ids, dtype=np.int64).reshape(-1))
-    fut = self.batcher.submit(ids, timeout_ms=timeout_ms)
-    # the batcher enforces the queue deadline; the extra slack here only
-    # guards against a wedged dispatcher
-    wait = timeout_ms if timeout_ms is not None \
-        else self._request_timeout_ms
-    out = fut.result(timeout=None if wait is None else wait / 1e3 + 60)
+    try:
+      fut = self.batcher.submit(ids, timeout_ms=timeout_ms)
+      # the batcher enforces the queue deadline (and the engine
+      # watchdog the dispatch); the extra slack here only guards
+      # against a wedged dispatcher with the watchdog disabled
+      wait = timeout_ms if timeout_ms is not None \
+          else self._request_timeout_ms
+      out = fut.result(timeout=None if wait is None else wait / 1e3 + 60)
+    except EngineStalledError:
+      # engine circuit OPEN: degrade to the cache tier if opted in —
+      # availability over freshness, every such answer counted
+      if not self.stale_serve:
+        raise
+      out = self._stale_infer(ids)
     self.metrics.record_request(t.stop(), np.asarray(ids).size)
     return out
+
+  def _stale_infer(self, ids) -> np.ndarray:
+    rows, cached = self.engine.stale_serve(ids)
+    self.metrics.record_stale_serve(int(cached.sum()))
+    self.metrics.add_gauge('stale_zero_fills', float((~cached).sum()))
+    return rows
 
   def stats(self) -> dict:
     out = self.metrics.snapshot(cache=self.engine.cache)
     out['engine'] = self.engine.compile_stats()
+    out['stalled'] = self.batcher.stalled
+    out['stale_serve_enabled'] = self.stale_serve
     return out
 
   def invalidate(self, ids=None, version=None) -> int:
@@ -117,13 +144,24 @@ class ServingClient:
     self._rpc = RpcClient(host, port, timeout=timeout)
 
   def infer(self, ids, timeout_ms: Optional[float] = None) -> np.ndarray:
+    # the client-supplied deadline ALSO bounds the rpc wait (plus small
+    # slack for the wire): a wedged server cannot hold this caller past
+    # its own deadline — the client times out, reconnects, and the
+    # request-id dedup makes the retry safe
+    rpc_timeout = (timeout_ms / 1e3 + 5.0
+                   if timeout_ms is not None else None)
     return np.asarray(self._rpc.request(
         'infer', np.asarray(ids, dtype=np.int64),
-        timeout_ms=timeout_ms))
+        timeout_ms=timeout_ms, _rpc_timeout=rpc_timeout))
 
   def infer_async(self, ids, timeout_ms: Optional[float] = None):
+    # same deadline contract as the sync path: the future must resolve
+    # within the caller's budget even against a wedged server
+    rpc_timeout = (timeout_ms / 1e3 + 5.0
+                   if timeout_ms is not None else None)
     return self._rpc.async_request(
-        'infer', np.asarray(ids, dtype=np.int64), timeout_ms=timeout_ms)
+        'infer', np.asarray(ids, dtype=np.int64),
+        timeout_ms=timeout_ms, _rpc_timeout=rpc_timeout)
 
   def stats(self) -> dict:
     return self._rpc.request('stats')

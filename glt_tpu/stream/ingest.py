@@ -77,6 +77,15 @@ class StreamIngestor:
       either way (memoized on the buffer's mutation_seq).
     expand_invalidation: pass touched ids through the snapshot's
       reverse-layout 1-hop expansion before cache invalidation.
+    restart_policy: what a background-tick exception does —
+      ``'restart'`` (default): log + keep the applier running, but
+      after ``max_tick_failures`` CONSECUTIVE failing ticks declare the
+      thread dead and surface the error; ``'raise'``: first tick
+      failure is fatal; ``'log'``: the pre-resilience behavior (log
+      forever, never surface — discouraged). A fatal background error
+      is re-raised from the next ``insert_edges`` / ``delete_edges`` /
+      ``update_features`` / ``flush`` / ``stop`` so writers can never
+      keep staging into a stream whose applier is a corpse.
   """
 
   def __init__(self, manager: SnapshotManager,
@@ -86,7 +95,12 @@ class StreamIngestor:
                metrics: Optional[ServingMetrics] = None,
                feature_capacity: Optional[int] = None,
                auto_refresh: bool = True,
-               expand_invalidation: bool = False):
+               expand_invalidation: bool = False,
+               restart_policy: str = 'restart',
+               max_tick_failures: int = 3):
+    assert restart_policy in ('restart', 'raise', 'log'), restart_policy
+    self.restart_policy = restart_policy
+    self.max_tick_failures = int(max_tick_failures)
     self.manager = manager
     self.sampler = sampler
     self.engine = engine
@@ -113,21 +127,41 @@ class StreamIngestor:
     self._last_compaction_ts: Optional[float] = None
     self._stop = threading.Event()
     self._thread: Optional[threading.Thread] = None
+    # background-failure surfacing: the last fatal tick error (None =
+    # healthy); once set, every staging call re-raises it
+    self._bg_error: Optional[BaseException] = None
+    self._tick_failures = 0      # consecutive failing ticks
+    self.tick_errors_total = 0   # lifetime count (observability)
     self._publish_gauges()
 
   # -- write API ---------------------------------------------------------
 
+  def _check_bg_error(self) -> None:
+    """Surface a fatal background-applier error on the caller's thread:
+    silently staging into a stream whose compaction loop died would
+    buffer updates that can never become visible."""
+    if self._bg_error is not None:
+      raise RuntimeError(
+          'stream ingest background applier died '
+          f'(restart_policy={self.restart_policy!r}, after '
+          f'{self.tick_errors_total} tick error(s)); no further '
+          'updates will compact — fix the cause and build a new '
+          'ingestor') from self._bg_error
+
   def insert_edges(self, src, dst) -> int:
+    self._check_bg_error()
     n = self.edges.insert_edges(src, dst)
     self._after_stage(refresh=True)
     return n
 
   def delete_edges(self, src, dst) -> int:
+    self._check_bg_error()
     n = self.edges.delete_edges(src, dst)
     self._after_stage(refresh=True)
     return n
 
   def update_features(self, ids, values) -> int:
+    self._check_bg_error()
     if self.features is None:
       raise ValueError(
           'this stream carries no Feature (SnapshotManager was built '
@@ -184,6 +218,7 @@ class StreamIngestor:
   def flush(self):
     """Force a compaction of everything pending; returns the info dict
     or None when there was nothing to fold."""
+    self._check_bg_error()
     with self._compact_lock:
       if self.edges.size == 0 \
           and (self.features is None or self.features.size == 0):
@@ -270,22 +305,45 @@ class StreamIngestor:
             self.sampler.refresh_overlay(self.edges)
           self._publish_gauges()
           self.maybe_compact()
-        except Exception:  # keep the applier alive; surface in logs
-          logger.exception('stream ingest tick failed')
+        except Exception as e:
+          self.tick_errors_total += 1
+          self._tick_failures += 1
+          logger.exception(
+              'stream ingest tick failed (%d consecutive, policy=%s)',
+              self._tick_failures, self.restart_policy)
+          if self.metrics is not None:
+            self.metrics.set_gauge('ingest_tick_errors',
+                                   float(self.tick_errors_total))
+          if self.restart_policy == 'log':
+            continue  # legacy: swallow forever
+          if (self.restart_policy == 'raise'
+              or self._tick_failures >= self.max_tick_failures):
+            # fatal: record for the next stage()/stop() to re-raise,
+            # then exit — a crash-looping applier must not keep
+            # draining/restaging the same poisoned cut forever
+            self._bg_error = e
+            return
+        else:
+          self._tick_failures = 0
 
     self._thread = threading.Thread(target=loop, daemon=True,
                                     name='glt-stream-ingest')
     self._thread.start()
     return self
 
-  def stop(self) -> None:
+  def stop(self, raise_background_error: bool = True) -> None:
     self._stop.set()
     if self._thread is not None:
       self._thread.join(timeout=10)
       self._thread = None
+    if raise_background_error:
+      self._check_bg_error()
 
   def __enter__(self):
     return self
 
-  def __exit__(self, *exc):
-    self.stop()
+  def __exit__(self, exc_type, exc, tb):
+    # when the body is already raising, a background-crash re-raise
+    # here would REPLACE that exception — report it only on the clean
+    # path
+    self.stop(raise_background_error=exc_type is None)

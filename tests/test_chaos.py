@@ -1,0 +1,455 @@
+"""Chaos scenarios: deterministic fault injection against the real
+rpc/serving/loader stack (marker ``chaos``; CI runs ``-m chaos`` with a
+pinned GLT_CHAOS_SEED so every fault path executes on every PR).
+
+Everything here drives REAL sockets/processes through the seeded
+:mod:`glt_tpu.resilience.chaos` harness — no mocks — asserting the
+degradation contracts of docs/fault_tolerance.md: bounded latency,
+counted (never silent) data loss, and no hangs."""
+import multiprocessing as mp
+import threading
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.chaos
+
+
+def build_long_ring_dataset():
+  """Module-level picklable builder (spawned sampling workers)."""
+  import sys, os
+  sys.path.insert(0, os.path.dirname(__file__))
+  from fixtures import ring_dataset
+  return ring_dataset(num_nodes=200, feat_dim=4)
+
+
+def build_ring_dataset_40():
+  import sys, os
+  sys.path.insert(0, os.path.dirname(__file__))
+  from fixtures import ring_dataset
+  return ring_dataset(num_nodes=40, feat_dim=4)
+
+
+# -- rpc hardening -------------------------------------------------------
+
+def test_rpc_client_survives_server_bounce():
+  """Satellite: a peer close must not kill the client — the socket is
+  re-established transparently on the next request."""
+  from glt_tpu.distributed.rpc import RpcClient, RpcServer
+  srv = RpcServer()
+  srv.register('add', lambda a, b: a + b)
+  cli = RpcClient(srv.host, srv.port, timeout=10)
+  assert cli.request('add', 2, 3) == 5
+  host, port = srv.host, srv.port
+  srv.stop()
+  time.sleep(0.1)
+  srv2 = RpcServer(host=host, port=port)  # bounced: same address
+  srv2.register('add', lambda a, b: a + b)
+  try:
+    assert cli.request('_ping')['ok']      # reconnects transparently
+    assert cli.request('add', 4, 5) == 9   # and serves non-idempotent
+    assert cli.reconnects >= 1
+  finally:
+    cli.close()
+    srv2.stop()
+
+
+def test_rpc_probe_token_released_on_caller_bug():
+  """An exception that aborts a request before it reaches the wire (an
+  unpicklable argument) must return the HALF_OPEN probe token — else
+  the breaker wedges OPEN forever against a healthy peer."""
+  from glt_tpu.distributed.rpc import RpcClient, RpcServer
+  from glt_tpu.resilience import CircuitBreaker
+  srv = RpcServer()
+  srv.register('echo', lambda x: x)
+  cli = RpcClient(srv.host, srv.port, timeout=10,
+                  breaker=CircuitBreaker(failure_threshold=1,
+                                         reset_timeout_s=0.0))
+  try:
+    cli.breaker.record_failure()       # tripped; timeout 0 => HALF_OPEN
+    with pytest.raises((TypeError, AttributeError)):  # pickle's error
+      cli.request('echo', lambda: 1)   # dies in pickle, pre-wire
+    # token returned: the next well-formed probe is admitted + closes
+    assert cli.request('echo', 7) == 7
+    assert cli.breaker.state == 'CLOSED'
+  finally:
+    cli.close()
+    srv.stop()
+
+
+def test_rpc_dedup_entry_released_after_next_request():
+  """Steady-state memory: a NEW request arriving on a connection proves
+  the client consumed the previous reply, so its cached dedup payload
+  is dropped immediately instead of pinning until the LRU cap."""
+  from glt_tpu.distributed.rpc import RpcClient, RpcServer
+  srv = RpcServer()
+  srv.register('echo', lambda x: x)
+  cli = RpcClient(srv.host, srv.port, timeout=10,
+                  idempotent=frozenset({'echo'}))
+  try:
+    for k in range(5):
+      assert cli.request('echo', k) == k
+    # receiving reply k+1 proves the server evicted entry k first:
+    # only the LAST request's reply may remain cached
+    with srv._lock:
+      assert len(srv._dedup) == 1
+  finally:
+    cli.close()
+    srv.stop()
+
+
+def test_rpc_retry_through_flaky_link_exactly_once():
+  """Drops/disconnects/delays on a seeded schedule: every request
+  eventually succeeds, and the server-side request-id dedup cache
+  guarantees each request EXECUTED exactly once even when only the
+  reply was lost."""
+  from glt_tpu.distributed.rpc import RpcClient, RpcServer
+  from glt_tpu.resilience import (
+      ChaosTcpProxy, CircuitBreaker, FaultPlan, RetryPolicy,
+  )
+  srv = RpcServer()
+  calls = {}
+  lock = threading.Lock()
+
+  def echo(x):
+    with lock:
+      calls[x] = calls.get(x, 0) + 1
+    return x * 2
+
+  srv.register('echo', echo)
+  plan = FaultPlan(seed=1234, drop=0.15, disconnect=0.1, delay=0.1,
+                   delay_s=0.01)
+  proxy = ChaosTcpProxy(srv.host, srv.port, plan)
+  cli = RpcClient(
+      *proxy.address, timeout=10,
+      retry=RetryPolicy(max_attempts=8, base_delay_s=0.01,
+                        max_delay_s=0.05, jitter=0),
+      breaker=CircuitBreaker(failure_threshold=1000),
+      idempotent=frozenset({'echo'}))
+  try:
+    # the budget exercises the deadline-slicing path but stays WIDE:
+    # this test asserts exactly-once execution, not tight latency, and
+    # how many faults one request eats depends on how its frames align
+    # with the proxy's per-connection schedules (timing-dependent) — a
+    # 0.5 s budget was observed to exhaust on a request that drew ~6
+    # consecutive faults when neighboring suites shifted the alignment
+    for i in range(60):
+      assert cli.request('echo', i, _rpc_timeout=5.0) == 2 * i
+    assert cli.retries > 0, 'chaos schedule injected no faults?'
+    faults = proxy.faults_injected
+    assert sum(faults.values()) > 0
+    multi = {k: v for k, v in calls.items() if v != 1}
+    assert not multi, f'dedup failed — double-executed: {multi}'
+    assert len(calls) == 60
+  finally:
+    cli.close()
+    proxy.close()
+    srv.stop()
+
+
+def test_circuit_breaker_fails_fast_on_dead_peer():
+  """A dead server costs the retry budget ONCE; every call after the
+  breaker opens fails in microseconds, not a 180 s timeout."""
+  from glt_tpu.distributed.rpc import RpcClient, RpcServer
+  from glt_tpu.resilience import (
+      CircuitBreaker, CircuitOpenError, RetryPolicy,
+  )
+  srv = RpcServer()
+  cli = RpcClient(
+      srv.host, srv.port, timeout=5,
+      retry=RetryPolicy(max_attempts=2, base_delay_s=0.01, jitter=0),
+      breaker=CircuitBreaker(failure_threshold=2, reset_timeout_s=60))
+  assert cli.request('_ping')['ok']
+  srv.stop()
+  time.sleep(0.1)
+  with pytest.raises(ConnectionError):
+    cli.request('_ping', _rpc_timeout=1.0)
+  t0 = time.monotonic()
+  with pytest.raises(CircuitOpenError):
+    cli.request('_ping')
+  assert time.monotonic() - t0 < 0.1, 'breaker did not fail fast'
+  assert cli.breaker.opens == 1
+  cli.close()
+
+
+def test_truncated_frame_recovers():
+  """A torn write (half a frame, then close) must surface as a clean
+  retryable failure, not corrupt the next request's framing."""
+  from glt_tpu.distributed.rpc import RpcClient, RpcServer
+  from glt_tpu.resilience import (
+      ChaosTcpProxy, CircuitBreaker, FaultPlan, RetryPolicy,
+  )
+  srv = RpcServer()
+  srv.register('big', lambda: bytes(100_000))
+  plan = FaultPlan(seed=7, truncate=0.25)
+  proxy = ChaosTcpProxy(srv.host, srv.port, plan)
+  cli = RpcClient(
+      *proxy.address, timeout=10,
+      retry=RetryPolicy(max_attempts=8, base_delay_s=0.01, jitter=0),
+      breaker=CircuitBreaker(failure_threshold=1000),
+      idempotent=frozenset({'big'}))
+  try:
+    for _ in range(20):
+      assert cli.request('big', _rpc_timeout=1.0) == bytes(100_000)
+    assert proxy.faults_injected['truncate'] > 0
+  finally:
+    cli.close()
+    proxy.close()
+    srv.stop()
+
+
+# -- serving degradation -------------------------------------------------
+
+def test_engine_stall_sheds_queued_and_bounds_latency():
+  """Injected engine stall: the watchdog fails the wedged batch AND the
+  queue within the stall budget (bounded p99 with a dead engine), the
+  circuit fails fast while open, and the engine's eventual return
+  closes it."""
+  from glt_tpu.serving import (
+      EngineStalledError, MicroBatcher, ServingMetrics,
+  )
+  gate = threading.Event()
+  entered = threading.Event()
+  wedge = threading.Event()
+
+  def handler(ids):
+    if wedge.is_set():
+      entered.set()
+      gate.wait(timeout=30)
+    return np.stack([ids.astype(np.float32)] * 2, axis=1)
+
+  m = ServingMetrics()
+  b = MicroBatcher(handler, max_batch_size=8, max_wait_ms=1.0,
+                   request_timeout_ms=5000.0, stall_timeout_ms=150.0,
+                   metrics=m)
+  try:
+    assert b.submit([1, 2]).result(timeout=10).shape == (2, 2)
+    wedge.set()
+    t0 = time.monotonic()
+    f1 = b.submit([3, 4])
+    assert entered.wait(timeout=10)   # the dispatch is provably wedged
+    f2 = b.submit([5])                # queued behind the corpse
+    for f in (f1, f2):
+      with pytest.raises(EngineStalledError):
+        f.result(timeout=10)
+    dt = time.monotonic() - t0
+    assert dt < 2.0, f'pending futures not failed promptly ({dt:.2f}s)'
+    assert b.stalled
+    with pytest.raises(EngineStalledError):
+      b.submit([6])                   # fail fast while OPEN
+    snap = m.snapshot()
+    assert snap['breaker_opens'] == 1
+    assert snap['shed'] >= 2          # queued victim + fast-failed
+    assert snap['gauges']['engine_stalled'] == 1.0
+    # the wedged call returning closes the circuit
+    wedge.clear()
+    gate.set()
+    deadline = time.monotonic() + 10
+    while b.stalled and time.monotonic() < deadline:
+      time.sleep(0.01)
+    assert not b.stalled
+    assert b.submit([7]).result(timeout=10).shape == (1, 2)
+    assert m.snapshot()['gauges']['engine_stalled'] == 0.0
+  finally:
+    gate.set()
+    b.stop()
+
+
+def test_dispatcher_survives_handler_death():
+  """Satellite: an exception escaping the dispatch fn fails the batch
+  with the ORIGINAL error and the dispatcher thread survives to serve
+  later submits (no stranding until request_timeout_ms)."""
+  from glt_tpu.serving import MicroBatcher
+
+  boom = {'on': False}
+
+  def handler(ids):
+    if boom['on']:
+      raise ZeroDivisionError('injected handler death')
+    return np.stack([ids.astype(np.float32)] * 2, axis=1)
+
+  b = MicroBatcher(handler, max_batch_size=8, max_wait_ms=1.0,
+                   request_timeout_ms=60_000.0)
+  try:
+    assert b.submit([1]).result(timeout=10).shape == (1, 2)
+    boom['on'] = True
+    t0 = time.monotonic()
+    with pytest.raises(ZeroDivisionError, match='injected'):
+      b.submit([2]).result(timeout=10)
+    assert time.monotonic() - t0 < 5, 'stranded until timeout'
+    boom['on'] = False
+    assert b.submit([3]).result(timeout=10).shape == (1, 2)
+  finally:
+    b.stop()
+
+
+# -- dist_server fetch deadline path (satellite) -------------------------
+
+def test_fetch_one_sampled_message_deadline_and_producer_death():
+  """The fetch deadline path: an empty channel times out CLEANLY (a
+  typed error, not a hang), a retry after the timeout succeeds, and a
+  producer death mid-epoch surfaces as the documented per-epoch
+  timeout."""
+  from glt_tpu.channel import QueueTimeoutError, pack_message
+  from glt_tpu.distributed.dist_server import DistServer, _END
+
+  ds = build_long_ring_dataset()
+  server = DistServer(ds, dataset_builder=build_long_ring_dataset)
+  cfg = dict(num_neighbors=[2], batch_size=4, shuffle=False,
+             drop_last=False, with_edge=False, collect_features=True,
+             seed=0)
+  # tiny buffer: the producer CANNOT finish the epoch ahead of the
+  # consumer, so a mid-epoch kill deterministically leaves the epoch
+  # unfinished (50 batches never fit in 64 KiB)
+  server.create_sampling_producer(
+      'k', pack_message({'seeds': np.arange(200)}), cfg,
+      num_workers=1, buffer_capacity=64 * 1024)
+  producer = server._producers['k']
+  try:
+    # 1) timeout before any epoch: clean typed error, bounded wall time
+    t0 = time.monotonic()
+    with pytest.raises(QueueTimeoutError):
+      server.fetch_one_sampled_message('k', 0, timeout_ms=300)
+    assert 0.2 < time.monotonic() - t0 < 5.0
+    # 2) retry after timeout succeeds once the epoch starts
+    server.start_new_epoch_sampling('k', 0)
+    out = server.fetch_one_sampled_message('k', 0, timeout_ms=30_000)
+    assert out != _END and len(out) > 0
+    # 3) producer death mid-epoch -> per-epoch timeout, not a hang
+    assert all(w.is_alive() for w in producer._workers)
+    for w in producer._workers:
+      w.terminate()
+      w.join(timeout=10)
+    t0 = time.monotonic()
+    with pytest.raises(QueueTimeoutError):
+      for _ in range(200):  # drain buffered, then time out
+        out = server.fetch_one_sampled_message('k', 0, timeout_ms=1500)
+        assert out != _END, 'epoch cannot end: its producer died'
+    assert time.monotonic() - t0 < 30
+    # 4) the healing boundary: the next epoch respawns the worker
+    server.start_new_epoch_sampling('k', 1)
+    out = server.fetch_one_sampled_message('k', 1, timeout_ms=30_000)
+    assert out != _END and len(out) > 0
+  finally:
+    server.exit()
+
+
+# -- kill 1-of-N servers mid-epoch (acceptance scenario) -----------------
+
+def _chaos_server_proc(rank, port, ready, done):
+  import sys, os
+  sys.path.insert(0, os.path.dirname(__file__))
+  from glt_tpu.utils.backend import force_backend
+  force_backend('cpu')
+  from glt_tpu.distributed import init_server, wait_and_shutdown_server
+  ds = build_ring_dataset_40()
+  init_server(num_servers=3, num_clients=1, server_rank=rank,
+              dataset=ds, master_port=port,
+              dataset_builder=build_ring_dataset_40)
+  ready.set()
+  wait_and_shutdown_server(poll_s=0.1)
+  done.set()
+
+
+@pytest.mark.slow
+def test_kill_one_of_three_servers_mid_epoch_completes():
+  """Acceptance: with 3 partition servers, killing one mid-epoch lets
+  the epoch COMPLETE from the survivors via retry + degradation — no
+  hang, no per-call 180 s stall — and the dropout is accounted in the
+  fabric health/metrics."""
+  import socket
+  from glt_tpu.distributed import (
+      RemoteDistSamplingWorkerOptions, RemoteNeighborLoader,
+      fabric_stats, init_client, shutdown_client,
+  )
+  from glt_tpu.resilience import RetryPolicy
+
+  # three consecutive free ports (server_port = master_port + rank)
+  base = None
+  for _ in range(50):
+    s = socket.socket(); s.bind(('127.0.0.1', 0))
+    cand = s.getsockname()[1]; s.close()
+    ok = True
+    for k in range(3):
+      t = socket.socket()
+      try:
+        t.bind(('127.0.0.1', cand + k))
+      except OSError:
+        ok = False
+      finally:
+        t.close()
+      if not ok:
+        break
+    if ok:
+      base = cand
+      break
+  assert base is not None
+
+  ctx = mp.get_context('spawn')
+  readies = [ctx.Event() for _ in range(3)]
+  dones = [ctx.Event() for _ in range(3)]
+  servers = [ctx.Process(target=_chaos_server_proc,
+                         args=(r, base, readies[r], dones[r]))
+             for r in range(3)]
+  for s in servers:
+    s.start()
+  for e in readies:
+    assert e.wait(timeout=120), 'server did not come up'
+
+  init_client(num_servers=3, num_clients=1, client_rank=0,
+              master_port=base, rpc_timeout=30.0,
+              retry=RetryPolicy(max_attempts=3, base_delay_s=0.05,
+                                max_delay_s=0.5, jitter=0),
+              breaker_threshold=3, health_interval_s=None)
+  try:
+    seeds = [np.arange(0, 13), np.arange(13, 26), np.arange(26, 40)]
+    loader = RemoteNeighborLoader(
+        [2], seeds, batch_size=5,
+        worker_options=RemoteDistSamplingWorkerOptions(
+            server_rank=[0, 1, 2], prefetch_size=1, rpc_timeout=30.0),
+        seed=0)
+    # healthy epoch: 3 + 3 + 3 batches
+    assert sum(1 for _ in loader) == 9
+    # epoch 2: kill server 1 after the first batches arrive
+    it = iter(loader)
+    got = [next(it), next(it)]
+    servers[1].kill()
+    servers[1].join(timeout=30)
+    t0 = time.monotonic()
+    got += list(it)                       # must TERMINATE, not hang
+    wall = time.monotonic() - t0
+    assert wall < 120, f'epoch drain took {wall:.0f}s'
+    assert 6 <= len(got) <= 9
+    assert loader.degraded_servers == {1}
+    stats = fabric_stats()
+    assert 1 in stats['dropouts'] or stats['health'].get(1) != 'UP'
+    # epoch 3: survivors keep serving full epochs minus the dead server
+    n3 = sum(1 for _ in loader)
+    assert n3 == 6, n3
+    seen = set()
+    # (re-run one more epoch collecting coverage of the survivors)
+    for b in loader:
+      nv = b.metadata['n_valid']
+      seen.update(np.asarray(b.batch)[:nv].tolist())
+    assert set(range(0, 13)) <= seen and set(range(26, 40)) <= seen
+    assert not (set(range(13, 26)) & seen)
+  finally:
+    shutdown_client()
+  for r in (0, 2):
+    assert dones[r].wait(timeout=60), f'server {r} did not exit cleanly'
+    servers[r].join(timeout=10)
+
+
+# -- chaos determinism (CI seed contract) --------------------------------
+
+def test_chaos_schedule_is_deterministic_across_runs():
+  """The CI contract: with GLT_CHAOS_SEED pinned, the exact same fault
+  schedule replays — including per-connection forks."""
+  from glt_tpu.resilience import FaultPlan
+  a = FaultPlan(seed=1234, drop=0.15, disconnect=0.1, delay=0.1)
+  b = FaultPlan(seed=1234, drop=0.15, disconnect=0.1, delay=0.1)
+  assert a.schedule(500) == b.schedule(500)
+  fa, fb = a.fork(9), b.fork(9)  # ONE fork each: compare whole streams
+  assert [fa.next_fault() for _ in range(100)] \
+      == [fb.next_fault() for _ in range(100)]

@@ -1,0 +1,295 @@
+"""Unit tests for the resilience primitives: retry policy, circuit
+breaker, health monitor, degraded feature cache, fault-plan
+determinism, and the ServingMetrics failure counters."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from glt_tpu.resilience import (
+    CLOSED, DEGRADED, DOWN, HALF_OPEN, OPEN, UP, ChaosChannel,
+    CircuitBreaker, CircuitOpenError, DegradedFeatureCache, FaultPlan,
+    HealthMonitor, RetryPolicy, chaos_seed, flaky,
+)
+from glt_tpu.serving import ServingMetrics
+
+
+# -- retry policy --------------------------------------------------------
+
+def test_retry_backoff_caps_and_grows():
+  p = RetryPolicy(max_attempts=5, base_delay_s=0.1, max_delay_s=0.5,
+                  jitter=0)
+  delays = [p.delay(a) for a in range(5)]
+  assert delays == [0.1, 0.2, 0.4, 0.5, 0.5]  # doubles, then capped
+
+
+def test_retry_jitter_bounds():
+  p = RetryPolicy(base_delay_s=0.1, max_delay_s=10.0, jitter=0.5)
+  for a in range(4):
+    base = min(0.1 * 2 ** a, 10.0)
+    for _ in range(50):
+      d = p.delay(a)
+      assert base * 0.5 <= d <= base + 1e-12
+
+
+# -- circuit breaker -----------------------------------------------------
+
+def test_breaker_trips_after_consecutive_failures_only():
+  b = CircuitBreaker(failure_threshold=3, reset_timeout_s=60)
+  for _ in range(2):
+    assert b.allow()
+    b.record_failure()
+  b.record_success()          # streak broken: an occasional flake
+  for _ in range(2):
+    assert b.allow()
+    b.record_failure()
+  assert b.state == CLOSED    # still under threshold
+  b.record_failure()
+  assert b.state == OPEN
+  assert not b.allow()        # fail fast
+  assert b.opens == 1
+
+
+def test_breaker_half_open_probe_closes_or_reopens():
+  b = CircuitBreaker(failure_threshold=1, reset_timeout_s=0.05)
+  b.record_failure()
+  assert b.state == OPEN and not b.allow()
+  time.sleep(0.06)
+  assert b.state == HALF_OPEN
+  assert b.allow()            # the single probe is admitted
+  assert not b.allow()        # second concurrent probe is NOT
+  b.record_failure()          # probe failed: re-open + re-arm
+  assert b.state == OPEN and b.opens == 2
+  time.sleep(0.06)
+  assert b.allow()
+  b.record_success()
+  assert b.state == CLOSED and b.allow()
+
+
+def test_breaker_release_probe_returns_token():
+  """A probe that aborts before exercising the peer (caller bug, e.g.
+  an unpicklable argument) must hand its HALF_OPEN token back — it is
+  neither a success nor a peer failure — or no probe is ever admitted
+  again and the breaker wedges OPEN against a healthy peer."""
+  b = CircuitBreaker(failure_threshold=1, reset_timeout_s=0.05)
+  b.record_failure()
+  time.sleep(0.06)
+  assert b.allow()            # probe token taken
+  assert not b.allow()
+  b.release_probe()           # aborted attempt: token returned
+  assert b.state == HALF_OPEN
+  assert b.allow()            # the NEXT probe is admitted
+  b.record_success()
+  assert b.state == CLOSED
+
+
+def test_breaker_on_open_fires_once_per_transition():
+  opens = []
+  b = CircuitBreaker(failure_threshold=2, reset_timeout_s=60,
+                     on_open=lambda: opens.append(1))
+  b.record_failure()
+  b.record_failure()
+  b.record_failure()          # already OPEN: no second event
+  assert len(opens) == 1
+
+
+# -- health monitor ------------------------------------------------------
+
+def test_health_monitor_thresholds_and_recovery():
+  ok = {'a': True, 'b': True}
+
+  def probe(name):
+    def run():
+      if not ok[name]:
+        raise ConnectionError('down')
+    return run
+
+  m = HealthMonitor({'a': probe('a'), 'b': probe('b')},
+                    degraded_after=1, down_after=3)
+  assert m.check_now() == {'a': UP, 'b': UP}
+  ok['b'] = False
+  assert m.check_now()['b'] == DEGRADED
+  m.check_now(); m.check_now()
+  assert m.status('b') == DOWN
+  assert m.healthy() == ['a']
+  ok['b'] = True
+  assert m.check_now()['b'] == UP   # one good probe fully recovers
+
+
+def test_health_monitor_passive_observations_and_background():
+  m = HealthMonitor({'s': lambda: None}, degraded_after=1, down_after=2)
+  m.record_failure('s')
+  assert m.status('s') == DEGRADED
+  m.record_failure('s')
+  assert m.is_down('s')
+  # the background prober (healthy probe fn) recovers it
+  m.start(interval_s=0.02)
+  try:
+    assert m.wait_for('s', UP, timeout_s=5)
+  finally:
+    m.stop()
+
+
+def test_health_monitor_allow_probe_rate_limited():
+  """Passive-only deployments (no background prober) rejoin a DOWN
+  peer via rate-limited probe-throughs: the first admission is
+  immediate, repeats wait out the interval."""
+  m = HealthMonitor({'s': lambda: None}, interval_s=0.1,
+                    degraded_after=1, down_after=1)
+  m.record_failure('s')
+  assert m.is_down('s')
+  assert m.allow_probe('s')          # first probe-through admitted
+  assert not m.allow_probe('s')      # rate-limited inside interval
+  time.sleep(0.11)
+  assert m.allow_probe('s')          # next window: admitted again
+  m.record_success('s')              # the probe-through succeeded
+  assert m.status('s') == UP
+
+
+# -- degraded feature cache ----------------------------------------------
+
+def test_degraded_cache_serves_stale_rows_and_zero_fills():
+  c = DegradedFeatureCache(capacity=100)
+  c.update([1, 2], np.array([[1., 1.], [2., 2.]], np.float32))
+  rows, mask = c.serve([2, 7, 1])
+  np.testing.assert_allclose(rows, [[2, 2], [0, 0], [1, 1]])
+  assert mask.tolist() == [True, False, True]
+
+
+def test_degraded_cache_unknown_width_raises():
+  with pytest.raises(RuntimeError):
+    DegradedFeatureCache().serve([1, 2])
+
+
+# -- chaos determinism ---------------------------------------------------
+
+def test_fault_plan_same_seed_same_schedule():
+  mk = lambda: FaultPlan(seed=77, drop=0.2, disconnect=0.1, delay=0.15)
+  a = [mk().next_fault() for _ in range(1)]  # noqa: F841 (api sanity)
+  p1, p2 = mk(), mk()
+  s1 = [p1.next_fault() for _ in range(200)]
+  s2 = [p2.next_fault() for _ in range(200)]
+  assert s1 == s2
+  assert any(f is not None for f in s1)
+  # forks are deterministic AND independent per salt — ONE fork each,
+  # whole streams compared (a fresh fork per draw only ever checks the
+  # first decision)
+  f1, f2 = p1.fork(3), p2.fork(3)
+  assert [f1.next_fault() for _ in range(50)] \
+      == [f2.next_fault() for _ in range(50)]
+  g1, g2 = p1.fork(4), p2.fork(4)
+  assert [g1.next_fault() for _ in range(50)] \
+      == [g2.next_fault() for _ in range(50)]
+  assert mk().schedule(200) == s1
+
+
+def test_fault_plan_start_after_and_max_faults():
+  p = FaultPlan(seed=1, drop=1.0, start_after=3, max_faults=2)
+  sched = [p.next_fault() for _ in range(10)]
+  assert sched[:3] == [None, None, None]
+  assert sched[3:5] == ['drop', 'drop']
+  assert sched[5:] == [None] * 5
+
+
+def test_chaos_seed_env_knob(monkeypatch):
+  monkeypatch.setenv('GLT_CHAOS_SEED', '4242')
+  assert chaos_seed() == 4242
+  assert FaultPlan(drop=0.5).seed == 4242
+  monkeypatch.delenv('GLT_CHAOS_SEED')
+  assert chaos_seed() == 0
+
+
+def test_flaky_wrapper_injects_connection_errors():
+  plan = FaultPlan(seed=5, disconnect=0.5)
+  fn = flaky(lambda x: x + 1, plan)
+  outcomes = []
+  for i in range(50):
+    try:
+      outcomes.append(fn(i))
+    except ConnectionError:
+      outcomes.append('boom')
+  assert 'boom' in outcomes and any(isinstance(o, int) for o in outcomes)
+
+
+def test_chaos_channel_drop_and_disconnect():
+  from glt_tpu.channel.mp_channel import MpChannel
+
+  class ListChannel:
+    def __init__(self):
+      self.items = []
+    def send(self, m):
+      self.items.append(m)
+    def recv(self, timeout_ms=1000):
+      if not self.items:
+        raise TimeoutError('empty')
+      return self.items.pop(0)
+    def empty(self):
+      return not self.items
+
+  plan = FaultPlan(seed=0, drop=1.0, max_faults=1)
+  ch = ChaosChannel(ListChannel(), plan)
+  ch.send({'a': 1}); ch.send({'a': 2})
+  # first message dropped, second delivered within the same budget
+  assert ch.recv(timeout_ms=1000) == {'a': 2}
+  plan2 = FaultPlan(seed=0, disconnect=1.0)
+  ch2 = ChaosChannel(ListChannel(), plan2)
+  ch2.send({'x': 1})
+  with pytest.raises(ConnectionError):
+    ch2.recv(timeout_ms=200)
+
+
+# -- metrics failure counters --------------------------------------------
+
+def test_metrics_failure_counters_in_snapshot():
+  m = ServingMetrics()
+  m.record_retry(); m.record_retry(2)
+  m.record_reconnect()
+  m.record_breaker_open()
+  m.record_shed(3)
+  m.record_stale_serve(4)
+  m.record_failover()
+  snap = m.snapshot()
+  assert snap['retries'] == 3
+  assert snap['reconnects'] == 1
+  assert snap['breaker_opens'] == 1
+  assert snap['shed'] == 3
+  assert snap['stale_serves'] == 4
+  assert snap['failovers'] == 1
+
+
+def test_metrics_counters_torn_read_safe():
+  """Mirror of the PR-3 hit_rate torn-read fix: hammer the failure
+  counters from writer threads while snapshotting concurrently; every
+  snapshot must show internally-consistent (never negative, never
+  beyond-final) values and the final totals must be exact."""
+  m = ServingMetrics()
+  N, W = 500, 4
+  stop = threading.Event()
+  bad = []
+
+  def writer():
+    for _ in range(N):
+      m.record_retry()
+      m.record_shed()
+      m.record_stale_serve()
+
+  def reader():
+    while not stop.is_set():
+      s = m.snapshot()
+      for k in ('retries', 'shed', 'stale_serves'):
+        if not (0 <= s[k] <= N * W):
+          bad.append(s)
+
+  threads = [threading.Thread(target=writer) for _ in range(W)]
+  r = threading.Thread(target=reader)
+  r.start()
+  for t in threads:
+    t.start()
+  for t in threads:
+    t.join()
+  stop.set()
+  r.join()
+  assert not bad
+  s = m.snapshot()
+  assert s['retries'] == s['shed'] == s['stale_serves'] == N * W

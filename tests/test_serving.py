@@ -400,5 +400,93 @@ def test_server_client_roundtrip(model_and_params):
       assert stats['engine']['forward_traces'] == {4: 1, 8: 1}
       assert stats['cache']['size'] > 0
       assert stats['latency_p99_ms'] >= stats['latency_p50_ms'] > 0
+      # resilience counters surface through ServingClient.stats()
+      for key in ('retries', 'reconnects', 'breaker_opens', 'shed',
+                  'stale_serves', 'failovers'):
+        assert stats[key] == 0, (key, stats[key])
+      assert stats['stalled'] is False
     finally:
       cli.close()
+
+
+# -- degradation tiers (resilience) --------------------------------------
+
+def test_stale_serve_answers_from_cache_while_engine_stalled(
+    model_and_params):
+  """Engine watchdog + opt-in stale-serve: a wedged forward opens the
+  engine circuit; requests are answered from the versioned
+  EmbeddingCache (zero-fill for misses) with bounded latency, every
+  stale answer counted; the wedged call returning closes the circuit
+  and serving resumes through the engine."""
+  from glt_tpu.serving import EngineStalledError
+
+  eng = make_engine(model_and_params, buckets=(4,))
+  srv = ServingServer(eng, max_wait_ms=1.0, request_timeout_ms=5000.0,
+                      stall_timeout_ms=150.0, stale_serve=True)
+  try:
+    primed_ids = np.array([1, 2, 3])
+    primed = srv.infer(primed_ids)          # fills the cache
+    # wedge the engine behind the batcher
+    gate = threading.Event()
+    wedge = threading.Event()
+    real = srv.batcher.handler
+
+    def wedging(ids):
+      if wedge.is_set():
+        gate.wait(timeout=30)
+      return real(ids)
+
+    srv.batcher.handler = wedging
+    wedge.set()
+    t0 = time.monotonic()
+    out = srv.infer([1, 2], timeout_ms=3000.0)  # rides the stall
+    dt = time.monotonic() - t0
+    np.testing.assert_allclose(out, primed[:2], rtol=1e-5)
+    assert dt < 2.0, f'stale serve not bounded by the watchdog ({dt}s)'
+    assert srv.batcher.stalled
+    # while OPEN: immediate stale answers, hits and misses both counted
+    out2 = srv.infer([3, 17])
+    np.testing.assert_allclose(out2[0], primed[2], rtol=1e-5)
+    np.testing.assert_allclose(out2[1], 0)   # true miss: zero-fill
+    stats = srv.stats()
+    assert stats['stalled'] is True
+    assert stats['stale_serves'] >= 3
+    assert stats['breaker_opens'] == 1
+    assert stats['gauges']['stale_zero_fills'] == 1
+    # p99 stays bounded by the deadline: every recorded request was
+    # either served fresh (fast) or stale (immediate)
+    assert stats['latency_p99_ms'] <= 3000.0
+    # release the wedge: circuit closes, engine serves again
+    wedge.clear()
+    gate.set()
+    deadline = time.monotonic() + 10
+    while srv.batcher.stalled and time.monotonic() < deadline:
+      time.sleep(0.01)
+    assert not srv.batcher.stalled
+    calls0 = eng.forward_calls
+    fresh = srv.infer([11, 12])
+    assert fresh.shape == (2, OUT_DIM)
+    assert eng.forward_calls > calls0        # really went through
+    assert srv.stats()['stalled'] is False
+  finally:
+    srv.close()
+
+
+def test_stale_serve_disabled_fails_fast(model_and_params):
+  """Without stale_serve the stall surfaces as EngineStalledError —
+  fail fast, never a silent zero answer."""
+  from glt_tpu.serving import EngineStalledError
+
+  eng = make_engine(model_and_params, buckets=(4,))
+  srv = ServingServer(eng, max_wait_ms=1.0, request_timeout_ms=5000.0,
+                      stall_timeout_ms=150.0, stale_serve=False)
+  try:
+    srv.infer([1])
+    gate = threading.Event()
+    real = srv.batcher.handler
+    srv.batcher.handler = lambda ids: (gate.wait(timeout=30), real(ids))[1]
+    with pytest.raises(EngineStalledError):
+      srv.infer([2], timeout_ms=3000.0)
+    gate.set()
+  finally:
+    srv.close()
