@@ -296,6 +296,23 @@ def run_worker():
       except Exception as e:  # keep the measured headline regardless
         train_ab = {'error': str(e)[:200]}
 
+  # Per-stage time breakdown (the obs layer): run a short instrumented
+  # sample->gather epoch with tracing + full device-sync sampling, then
+  # report each stage's share next to the headline. Fixed smoke-scale
+  # protocol independent of the headline knobs; budget-guarded, never
+  # fatal. GLT_OBS_DUMP=<dir> additionally writes the registry snapshot
+  # and a Perfetto-loadable trace JSON there (the CI smoke-bench
+  # artifacts).
+  stage_breakdown = None
+  if os.environ.get('GLT_BENCH_OBS', '1') != '0':
+    spent = time.time() - t_start
+    if not worker_budget or worker_budget - spent > 120:
+      try:
+        stage_breakdown = measure_stage_breakdown(
+            dump_dir=os.environ.get('GLT_OBS_DUMP'))
+      except Exception as e:  # keep the measured headline regardless
+        stage_breakdown = {'error': str(e)[:200]}
+
   _emit(round(eps, 1), round(eps / A100_ASSUMED_EDGES_PER_SEC, 4),
         backend=dev.platform, scan=scan, iters=ITERS, batch=BATCH,
         engine=chosen,
@@ -304,7 +321,89 @@ def run_worker():
                       'steady_recompiles': v['steady_recompiles']}
                      if isinstance(v, dict) else v)
                  for k, v in engines.items()},
-        train_steps_per_sec=train_ab)
+        train_steps_per_sec=train_ab,
+        stage_breakdown=stage_breakdown)
+
+
+def measure_stage_breakdown(batches: int = 8, num_nodes: int = 100_000,
+                            num_edges: int = 1_000_000,
+                            feat_dim: int = 16,
+                            batch_size: int = 1024,
+                            dump_dir=None):
+  """Instrumented sample->dedup->gather pass over a smoke-scale graph:
+  glt_tpu.obs tracing on, device-sync sampling at 1.0 so every span
+  covers real compute, per-stage times aggregated from the registry's
+  ``stage_seconds`` histograms. Returns {stage: {total_ms, mean_ms,
+  count}} plus the warmup compile wall time."""
+  import numpy as np
+  from glt_tpu.data import Dataset
+  from glt_tpu.loader import NeighborLoader
+  from glt_tpu.obs import MetricsRegistry, get_tracer, set_registry
+
+  rng = np.random.default_rng(7)
+  src = rng.integers(0, num_nodes, num_edges, dtype=np.int64)
+  dst = rng.integers(0, num_nodes, num_edges, dtype=np.int64)
+  ds = Dataset()
+  ds.init_graph(edge_index=np.stack([src, dst]), num_nodes=num_nodes)
+  ds.init_node_features(
+      rng.random((num_nodes, feat_dim)).astype(np.float32))
+  seeds = rng.integers(0, num_nodes, (batches + 1) * batch_size)
+
+  tracer = get_tracer()
+  was_enabled, prev_sample = tracer.enabled, tracer._sample
+  prev_registry = set_registry(MetricsRegistry())  # isolated aggregation
+  tracer.enable(sample=1.0)
+  try:
+    loader = NeighborLoader(ds, list(FANOUT), seeds,
+                            batch_size=batch_size, seed=0)
+    it = iter(loader)
+    t0 = time.time()
+    next(it)  # first batch pays trace+compile; keep it out of the stats
+    warm_s = time.time() - t0
+    tracer.clear()
+    set_registry(MetricsRegistry())  # drop warmup-batch observations
+    for _ in range(batches):
+      next(it)
+    from glt_tpu.obs import get_registry
+    snap = get_registry().snapshot()
+    out = {'warmup_compile_s': round(warm_s, 2), 'batches': batches}
+    # spans NEST (loader.batch encloses sample.multihop and
+    # gather.features), so raw per-stage totals double-count; report
+    # self time (own duration minus direct children) so the stage
+    # shares sum to ~wall — total_ms stays alongside for the
+    # enclosing-span view
+    events = tracer.events()
+    child_dur = {}
+    for e in events:
+      p = e['args'].get('parent_id')
+      if p is not None:
+        child_dur[p] = child_dur.get(p, 0) + e['dur']
+    stages = {}
+    for e in events:
+      s = stages.setdefault(e['name'],
+                            {'total_ms': 0.0, 'self_ms': 0.0,
+                             'count': 0})
+      s['total_ms'] += e['dur'] / 1e3
+      s['self_ms'] += (e['dur']
+                       - child_dur.get(e['args']['span_id'], 0)) / 1e3
+      s['count'] += 1
+    out['stages'] = {
+        name: {'total_ms': round(s['total_ms'], 2),
+               'self_ms': round(s['self_ms'], 2),
+               'mean_ms': round(s['total_ms'] / max(s['count'], 1), 3),
+               'count': s['count']}
+        for name, s in sorted(stages.items())
+    }
+    if dump_dir:
+      with open(os.path.join(dump_dir, 'obs_registry.json'), 'w') as f:
+        json.dump(snap, f, indent=2)
+      tracer.save(os.path.join(dump_dir, 'obs_trace.json'))
+    return out
+  finally:
+    set_registry(prev_registry)
+    tracer.enabled = was_enabled
+    tracer._sample = prev_sample
+    tracer.clear()
 
 
 def run_probe():

@@ -14,6 +14,7 @@ _compat.install()  # backfill jax.shard_map / jax.memory on older jax
 
 from . import typing  # noqa: F401
 from . import utils  # noqa: F401
+from . import obs  # noqa: F401
 from . import data  # noqa: F401
 from . import ops  # noqa: F401
 from . import sampler  # noqa: F401

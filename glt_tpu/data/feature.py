@@ -364,6 +364,17 @@ def gather_features(feat: Optional[Feature], node,
   stream snapshot updates) because it rides the call, not the store."""
   if feat is None:
     return None
+  from ..obs import get_tracer
+  tracer = get_tracer()
+  if tracer.enabled:
+    _out = {}
+    with tracer.span('gather.features', sync=lambda: _out.get('x')):
+      _out['x'] = x = _gather_features(feat, node, row_gather)
+    return x
+  return _gather_features(feat, node, row_gather)
+
+
+def _gather_features(feat: Feature, node, row_gather):
   rows = feat.map_ids(node)
   if feat.fully_device_resident:
     return feat.device_gather(rows, row_gather=row_gather)

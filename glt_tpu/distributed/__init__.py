@@ -31,8 +31,9 @@ from .dist_server import (
     wait_and_shutdown_server,
 )
 from .dist_client import (
-    async_request_server, fabric_stats, init_client, request_server,
-    request_with_failover, set_replicas, shutdown_client,
+    async_request_server, collect_obs, export_fabric_trace,
+    fabric_stats, init_client, request_server, request_with_failover,
+    set_replicas, shutdown_client,
 )
 
 __all__ += [
@@ -44,7 +45,7 @@ __all__ += [
     'wait_and_shutdown_server',
     'async_request_server', 'init_client', 'request_server',
     'shutdown_client', 'request_with_failover', 'set_replicas',
-    'fabric_stats',
+    'fabric_stats', 'collect_obs', 'export_fabric_trace',
 ]
 from .dist_hetero import DistHeteroGraph, DistHeteroNeighborSampler, \
     DistHeteroTrainStep

@@ -47,10 +47,16 @@ def init_client(num_servers: int, num_clients: int, client_rank: int,
                 retry: Optional[RetryPolicy] = None,
                 breaker_threshold: int = 5,
                 breaker_reset_s: float = 5.0,
-                health_interval_s: Optional[float] = 1.0) -> None:
+                health_interval_s: Optional[float] = 1.0,
+                registry=None) -> None:
   """``health_interval_s=None`` disables the background prober (passive
   health from the request path still applies); the other knobs
-  parameterize each per-server RpcClient's retry/breaker stack."""
+  parameterize each per-server RpcClient's retry/breaker stack.
+  ``registry``: publish the fabric failure counters into a shared
+  MetricsRegistry (e.g. ``glt_tpu.obs.get_registry()``, labeled
+  ``view="dist_client"``) instead of a private per-session one —
+  private stays the default so each init_client session's counters
+  start from zero."""
   global _num_servers, _client_rank, _num_clients, _health, _metrics, \
       _feat_cache
   from ..serving.metrics import ServingMetrics
@@ -58,7 +64,9 @@ def init_client(num_servers: int, num_clients: int, client_rank: int,
   _num_servers = num_servers
   _client_rank = client_rank
   _num_clients = num_clients
-  _metrics = ServingMetrics()
+  _metrics = ServingMetrics(registry=registry,
+                            name='dist_client' if registry is not None
+                            else '')
   _dropouts.clear()
   _replicas.clear()
   # fresh per client session: rows cached against a PREVIOUS session's
@@ -190,6 +198,40 @@ def fabric_stats() -> dict:
       'dropouts': sorted(_dropouts),
       'degraded_cache_rows': len(_feat_cache),
   }
+
+
+def collect_obs(server_rank: int) -> dict:
+  """Harvest one server's obs buffers (finished trace spans as
+  Chrome-event dicts + its registry snapshot) through the rpc fabric's
+  built-in ``_obs`` callee."""
+  return request_server(server_rank, '_obs')
+
+
+def export_fabric_trace(path: str,
+                        trace_id: Optional[str] = None) -> str:
+  """Assemble ONE Chrome-trace/Perfetto JSON for the whole fabric: this
+  client's spans merged with every reachable server's handler spans.
+  Server-side spans carry the trace ids the client propagated over rpc,
+  so they nest under the originating client spans in the merged view.
+  ``trace_id`` filters to a single trace; unreachable servers are
+  skipped (a dead peer must not block exporting everyone else)."""
+  from ..obs import get_tracer, merge_chrome_traces
+
+  def keep(events):
+    if trace_id is None:
+      return events
+    return [e for e in events if e['args'].get('trace_id') == trace_id]
+
+  lists = [keep(get_tracer().events())]
+  for s in range(_num_servers):
+    try:
+      lists.append(keep(collect_obs(s)['events']))
+    except Exception as e:  # noqa: BLE001 — harvest is best-effort
+      logger.warning('obs harvest from server %d failed: %s', s, e)
+  import json
+  with open(path, 'w') as f:
+    json.dump(merge_chrome_traces(*lists), f)
+  return path
 
 
 def apply_delta(server_rank: int, ins=None, dels=None, feat_ids=None,

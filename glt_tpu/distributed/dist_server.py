@@ -53,11 +53,15 @@ class DistServer:
   def ping(self) -> dict:
     """Liveness + readiness probe (HealthMonitor target; richer than
     the rpc fabric's built-in ``_ping``)."""
+    from ..obs import get_tracer
     return {
         'ok': True,
         'exiting': self._exit.is_set(),
         'producers': len(self._producers),
         'partition_idx': getattr(self.dataset, 'partition_idx', 0),
+        # surfaced so a fleet sweep can see which peers are tracing
+        # (their span buffers are harvestable via the _obs builtin)
+        'obs_tracing': get_tracer().enabled,
     }
 
   def get_dataset_meta(self):

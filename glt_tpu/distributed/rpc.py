@@ -23,6 +23,7 @@ from collections import OrderedDict
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Dict, FrozenSet, List, Optional
 
+from ..obs.trace import get_tracer
 from ..resilience.retry import (
     CircuitBreaker, CircuitOpenError, RetryPolicy,
 )
@@ -39,7 +40,7 @@ IDEMPOTENT_CALLEES: FrozenSet[str] = frozenset({
     'get_node_feature', 'get_node_label', 'get_dataset_meta',
     'get_tensor_size', 'get_edge_index', 'get_edge_size',
     'get_node_partition_id', 'fetch_one_sampled_message',
-    'infer', 'stats', 'ping', '_ping',
+    'infer', 'stats', 'ping', '_ping', '_obs',
 })
 
 
@@ -126,6 +127,7 @@ class RpcServer:
     self.register('_barrier', self._barrier)
     self.register('_gather', self._gather)
     self.register('_ping', self._ping)
+    self.register('_obs', self._obs)
     self._accept_thread = None
     if auto_start:
       self.start()
@@ -174,6 +176,17 @@ class RpcServer:
     targets this; servers may also register a richer 'ping')."""
     with self._lock:
       return {'ok': True, 'callees': len(self._callees)}
+
+  def _obs(self) -> dict:
+    """Built-in observability harvest every endpoint answers: this
+    process's finished trace spans (Chrome-event dicts) + the global
+    registry snapshot. A client assembling a cross-machine trace pulls
+    each peer's buffer through here (obs.collect_endpoint_obs) and
+    merges — server-side handler spans carry the caller's trace id, so
+    they slot under the originating client spans."""
+    from ..obs import get_registry
+    return {'events': get_tracer().events(),
+            'metrics': get_registry().snapshot()}
 
   # built-in synchronization callees (reference rpc.py:105-235)
   def _barrier(self, key: str, world: int) -> bool:
@@ -282,10 +295,12 @@ class RpcServer:
         msg = _recv_msg(conn)
       except (ConnectionError, EOFError, OSError):
         return
-      # wire format: (name, args, kwargs[, req_id]) — the 4th element
-      # rides only on retryable requests
+      # wire format: (name, args, kwargs[, req_id[, trace_ctx]]) — the
+      # 4th element rides only on retryable requests (None placeholder
+      # when only tracing), the 5th only on trace-sampled requests
       name, args, kwargs = msg[0], msg[1], msg[2]
       req_id = msg[3] if len(msg) > 3 else None
+      trace_ctx = msg[4] if len(msg) > 4 else None
       # any subsequent request on this connection proves the client
       # consumed the previous reply (serial per connection; a retry
       # after a drop redials) — release the cached payload now instead
@@ -304,7 +319,14 @@ class RpcServer:
         continue
       try:
         fn = self._resolve(name)
-        reply = ('ok', fn(*args, **kwargs))
+        # reopen the caller's span context (if any) around the handler:
+        # the server-side span shares the client's trace id and parents
+        # under the client's rpc span, so a harvested + merged trace
+        # nests correctly across processes. With no incoming context
+        # this is a local span (or a cached no-op when tracing is off).
+        with get_tracer().remote_span(f'rpc.server:{name}', trace_ctx,
+                                      callee=name):
+          reply = ('ok', fn(*args, **kwargs))
       except BaseException as e:  # deliver errors to the caller
         try:
           pickle.dumps(e)
@@ -451,7 +473,8 @@ class RpcClient:
 
   def _request_once(self, name: str, args, kwargs,
                     req_id: Optional[str],
-                    rpc_timeout: Optional[float]):
+                    rpc_timeout: Optional[float],
+                    trace_ctx=None):
     """One attempt over the (re)established socket. Raises
     ``_SendPhaseError`` when the failure provably predates delivery
     (safe to retry for any callee)."""
@@ -464,8 +487,14 @@ class RpcClient:
         self.reconnects += 1
         if self.metrics is not None:
           self.metrics.record_reconnect()
-      msg = ((name, args, kwargs, req_id) if req_id is not None
-             else (name, args, kwargs))
+      if trace_ctx is not None:
+        # trace context rides a 5th element; req_id keeps slot 3 (None
+        # placeholder is fine — the server treats it as untracked)
+        msg = (name, args, kwargs, req_id, tuple(trace_ctx))
+      elif req_id is not None:
+        msg = (name, args, kwargs, req_id)
+      else:
+        msg = (name, args, kwargs)
       try:
         _send_msg(self._sock, msg)
       except (ConnectionError, OSError) as e:
@@ -501,7 +530,22 @@ class RpcClient:
     remaining slice, and the retry loop stops once the budget is spent
     — a wedged peer cannot hold the caller for attempts x timeout.
     Connection errors engage reconnect/retry/breaker as described on
-    the class."""
+    the class.
+
+    With tracing enabled (glt_tpu.obs) the call runs inside an
+    ``rpc.client:<name>`` span whose context ships with the request,
+    so the peer's handler span nests under it in a merged trace."""
+    tracer = get_tracer()
+    if not tracer.enabled:
+      return self._request_with_retries(name, args, kwargs,
+                                        _rpc_timeout, None)
+    with tracer.span(f'rpc.client:{name}', cat='rpc', callee=name,
+                     peer=f'{self._addr[0]}:{self._addr[1]}') as ctx:
+      return self._request_with_retries(name, args, kwargs,
+                                        _rpc_timeout, ctx)
+
+  def _request_with_retries(self, name: str, args, kwargs,
+                            _rpc_timeout: Optional[float], trace_ctx):
     retryable = name in self._idempotent
     attempts = self._retry.max_attempts
     req_id = (f'{self._req_prefix}.{next(self._req_seq)}'
@@ -524,7 +568,8 @@ class RpcClient:
         budget = remaining / (attempts - attempt) if retryable \
             else remaining
       try:
-        out = self._request_once(name, args, kwargs, req_id, budget)
+        out = self._request_once(name, args, kwargs, req_id, budget,
+                                 trace_ctx=trace_ctx)
       except _CalleeError as e:
         # callee-raised error: delivered + executed — the peer is
         # healthy, so neither the breaker nor the retry loop applies
@@ -560,6 +605,14 @@ class RpcClient:
     raise last
 
   def async_request(self, name: str, *args, **kwargs) -> Future:
+    if get_tracer().enabled:
+      # propagate the caller's span context into the pool thread —
+      # without this every async rpc span would open as an orphan root
+      # and fall out of the assembled cross-process trace
+      import contextvars
+      ctx = contextvars.copy_context()
+      return self._pool.submit(ctx.run, self.request, name, *args,
+                               **kwargs)
     return self._pool.submit(self.request, name, *args, **kwargs)
 
   def close(self) -> None:

@@ -18,6 +18,7 @@ import numpy as np
 
 from ..data import Dataset, Feature
 from ..data.feature import gather_features
+from ..obs import get_registry, get_tracer
 from ..sampler import BaseSampler, NodeSamplerInput, SamplerOutput
 from ..utils import as_numpy
 from .device_epoch import pad_seed_batch
@@ -70,6 +71,10 @@ class NodeLoader:
     self.prefetch_depth = int(prefetch_depth)
     self.rng = rng or np.random.default_rng(0)
     self._gather_cache = {}
+    # resolved once: per-batch inc() is then a single lock hold instead
+    # of a registry lookup per iteration (the registry's hot-path rule)
+    self._batches_counter = get_registry().counter(
+        'loader_batches_total')
 
   @staticmethod
   def _has_host_phase(data) -> bool:
@@ -124,7 +129,17 @@ class NodeLoader:
       # rule as the superstep epoch stack, device_epoch.pad_seed_batch)
       seeds, n_valid = pad_seed_batch(self.seeds[order[lo:hi]],
                                       self.batch_size)
-      yield self._make_batch(seeds, n_valid)
+      # counter advances regardless of tracing: metrics exposition and
+      # the tracing knob are independent surfaces
+      self._batches_counter.inc()
+      tracer = get_tracer()
+      if tracer.enabled:
+        with tracer.span('loader.batch', batch=self.batch_size,
+                         n_valid=int(n_valid)):
+          batch = self._make_batch(seeds, n_valid)
+        yield batch
+      else:
+        yield self._make_batch(seeds, n_valid)
 
   # -- collate (reference node_loader.py:87-115 _collate_fn) -------------
 

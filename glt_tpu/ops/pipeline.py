@@ -204,13 +204,18 @@ def multihop_sample(one_hop: OneHopFn,
   hop_node_counts = [seed_count]
   hop_edge_counts = []
   cap = batch_size
-  for fanout in fanouts:
+  for hop_idx, fanout in enumerate(fanouts):
     width = abs(fanout)  # negative = full-neighborhood hop, window |k|
     key, sub = jax.random.split(key)
-    out = one_hop(frontier_ids, fanout, sub, frontier_mask)
+    # named_scope: trace-time-only labels so device profiler traces
+    # (jax.profiler / xprof) break the fused program down by pipeline
+    # stage — the in-jit counterpart of the host-side obs spans
+    with jax.named_scope(f'sample_hop{hop_idx}'):
+      out = one_hop(frontier_ids, fanout, sub, frontier_mask)
     prev_count = state.count
-    state, labels_flat = dense_assign(
-        state, out.nbrs.reshape(-1), out.mask.reshape(-1))
+    with jax.named_scope(f'dedup{hop_idx}'):
+      state, labels_flat = dense_assign(
+          state, out.nbrs.reshape(-1), out.mask.reshape(-1))
     rows_parent.append(jnp.repeat(frontier_labels, width))
     cols_child.append(labels_flat)
     emasks.append(out.mask.reshape(-1))
@@ -276,18 +281,22 @@ def _multihop_sample_sorted(one_hop: OneHopFn,
   rows_parent, cols_child, emasks, eid_list = [], [], [], []
   hop_node_counts = [seed_count]
   hop_edge_counts = []
-  for fanout in fanouts:
+  for hop_idx, fanout in enumerate(fanouts):
     width = abs(fanout)
     key, sub = jax.random.split(key)
-    out = one_hop(frontier_ids, fanout, sub, frontier_mask)
+    # trace-time stage labels for device profiler traces (the in-jit
+    # counterpart of the host obs spans; see multihop_sample above)
+    with jax.named_scope(f'sample_hop{hop_idx}'):
+      out = one_hop(frontier_ids, fanout, sub, frontier_mask)
     rows_flat = jnp.repeat(frontier_labels, width)
     ids_flat = out.nbrs.reshape(-1)
     mask_flat = out.mask.reshape(-1)
     if fused:
       # single-sort assign; per-element outputs come back in SLOT
       # order, so edge payloads (rows/mask/eids) never ride a sort
-      d = sorted_hop_dedup_fused(u_ids, u_labs, count, ids_flat,
-                                 mask_flat)
+      with jax.named_scope(f'dedup{hop_idx}'):
+        d = sorted_hop_dedup_fused(u_ids, u_labs, count, ids_flat,
+                                   mask_flat)
       rows_parent.append(rows_flat)
       cols_child.append(d['labels3'])
       emasks.append(mask_flat)
@@ -298,8 +307,9 @@ def _multihop_sample_sorted(one_hop: OneHopFn,
                                jnp.iinfo(jnp.int32).max)
     else:
       eflat = out.eids.reshape(-1) if with_edge else None
-      d = sorted_hop_dedup(u_ids, u_labs, count, ids_flat, mask_flat,
-                           rows_flat, eflat, with_mask=True)
+      with jax.named_scope(f'dedup{hop_idx}'):
+        d = sorted_hop_dedup(u_ids, u_labs, count, ids_flat, mask_flat,
+                             rows_flat, eflat, with_mask=True)
       rows_parent.append(d['rows3'])
       cols_child.append(d['labels3'])
       emasks.append(d['mask3'])

@@ -306,16 +306,34 @@ class SPMDSageTrainStep:
     """
     seeds, n_valid, keys = self._stacked_put(seeds_stack, n_valid_stack,
                                              keys)
+    from ..obs import get_registry, get_tracer
+    tracer = get_tracer()
     if self._streaming:
-      staged = self._sample_and_stage(seeds, n_valid, keys)
-      return self._consume(params, opt_state, staged, n_valid)
+      with tracer.span('train.superstep', streaming=True,
+                       k=int(seeds.shape[0])):
+        staged = self._sample_and_stage(seeds, n_valid, keys)
+        out = self._consume(params, opt_state, staged, n_valid)
+      if tracer.enabled:
+        get_registry().set('train_superstep_traces',
+                           float(self.superstep_traces))
+      return out
     extra = ((self.feature.cold_array,)
              if self.feature.cold_array is not None else ())
-    (params, opt_state, self.tables, self.scratches,
-     loss) = self._superstep_fn(
-         params, opt_state, self.tables, self.scratches, seeds, n_valid,
-         keys, self.feature.array, self.labels, self._indptr,
-         self._indices, *extra)
+    _synced = {}
+    with tracer.span('train.superstep', k=int(seeds.shape[0]),
+                     sync=lambda: _synced.get('loss')):
+      (params, opt_state, self.tables, self.scratches,
+       loss) = self._superstep_fn(
+           params, opt_state, self.tables, self.scratches, seeds,
+           n_valid, keys, self.feature.array, self.labels, self._indptr,
+           self._indices, *extra)
+      _synced['loss'] = loss
+    if tracer.enabled:
+      # re-trace visibility on the shared surface: the zero-steady-
+      # state-recompile asserts read the attributes; dashboards read
+      # these gauges
+      get_registry().set('train_superstep_traces',
+                         float(self.superstep_traces))
     return params, opt_state, loss
 
   # -- cold-row streaming: sample scan + host stage + consume scan --------
@@ -522,8 +540,16 @@ class SPMDSageTrainStep:
         NamedSharding(self.mesh, P(self.axis)))
     extra = ((self.feature.cold_array,)
              if self.feature.cold_array is not None else ())
-    params, opt_state, self.tables, self.scratches, loss = self._step_fn(
-        params, opt_state, self.tables, self.scratches, seeds, n_valid,
-        keys, self.feature.array, self.labels, self._indptr,
-        self._indices, *extra)
+    from ..obs import get_registry, get_tracer
+    tracer = get_tracer()
+    _synced = {}
+    with tracer.span('train.step', sync=lambda: _synced.get('loss')):
+      (params, opt_state, self.tables, self.scratches,
+       loss) = self._step_fn(
+           params, opt_state, self.tables, self.scratches, seeds,
+           n_valid, keys, self.feature.array, self.labels, self._indptr,
+           self._indices, *extra)
+      _synced['loss'] = loss
+    if tracer.enabled:
+      get_registry().set('train_step_traces', float(self.step_traces))
     return params, opt_state, loss

@@ -34,6 +34,7 @@ from ..ops.sample import (
     neighbor_probs, sample_full_neighbors, sample_neighbors,
     sample_neighbors_weighted,
 )
+from ..obs import get_tracer
 from ..ops.subgraph import induced_subgraph
 from ..typing import EdgeType, NodeType, reverse_edge_type
 from ..utils import as_numpy
@@ -276,7 +277,8 @@ class NeighborSampler(BaseSampler):
     plain array of seed ids; padded seeds (beyond ``n_valid``) are ignored.
     """
     if self.is_hetero:
-      return self._hetero_sample_from_nodes(inputs, **kwargs)
+      with get_tracer().span('sample.multihop', kind='hetero'):
+        return self._hetero_sample_from_nodes(inputs, **kwargs)
     if isinstance(inputs, NodeSamplerInput):
       seeds = as_numpy(inputs.node)
     else:
@@ -287,9 +289,17 @@ class NeighborSampler(BaseSampler):
     if cache_key not in self._fn_cache:
       self._fn_cache[cache_key] = self._build_homo_fn(batch_size)
     table, scratch = self._get_tables('', self.graph.num_nodes)
-    out, table, scratch = self._fn_cache[cache_key](
-        jnp.asarray(seeds.astype(np.int32)), jnp.asarray(n_valid),
-        kwargs.get('key', self._next_key()), table, scratch)
+    # dispatch is async: the sync closure hands the output back to the
+    # span so sampled device-syncs (GLT_OBS_TRACE_SAMPLE) measure real
+    # compute, not just dispatch
+    _synced = {}
+    with get_tracer().span('sample.multihop', batch=batch_size,
+                           hops=len(self.num_neighbors),
+                           sync=lambda: _synced.get('out')):
+      out, table, scratch = self._fn_cache[cache_key](
+          jnp.asarray(seeds.astype(np.int32)), jnp.asarray(n_valid),
+          kwargs.get('key', self._next_key()), table, scratch)
+      _synced['out'] = out['num_sampled_edges']
     self._tables[''] = (table, scratch)
     return SamplerOutput(
         node=out['node'], node_count=out['node_count'],

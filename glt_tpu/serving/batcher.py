@@ -347,7 +347,10 @@ class MicroBatcher:
         # the capacity so the fill ratio stays a [0, 1] utilization
         self.metrics.record_batch(ids.size,
                                   max(ids.size, self.max_batch_size))
-      out = self.handler(ids)
+      from ..obs import get_tracer
+      with get_tracer().span('serve.flush', requests=len(batch),
+                             ids=int(ids.size)):
+        out = self.handler(ids)
       out = np.asarray(out)
       if out.shape[0] != ids.size:
         # a real error, not an assert: under python -O a misaligned

@@ -33,6 +33,7 @@ import numpy as np
 from ..data import Dataset
 from ..data.feature import gather_features
 from ..loader.transform import to_batch
+from ..obs import get_tracer
 from ..sampler import NeighborSampler
 from ..utils import as_numpy
 from .embedding_cache import EmbeddingCache
@@ -193,10 +194,17 @@ class InferenceEngine:
       padded = np.concatenate(
           [padded, np.full(bucket - padded.shape[0], padded[0] if
                            padded.size else 0, padded.dtype)])
-    batch = self.make_batch(padded, n_valid, bucket)
-    emb = self._forward(bucket)(self.params, batch)
-    self.forward_calls += 1
-    rows = np.asarray(emb)[:n_valid]
+    tracer = get_tracer()
+    # sample.multihop / gather.features spans open inside make_batch;
+    # the bucket span parents them and (np.asarray below is a full
+    # device sync) carries the true end-to-end stage time
+    with tracer.span('serve.bucket', bucket=bucket,
+                     n_valid=int(n_valid)):
+      batch = self.make_batch(padded, n_valid, bucket)
+      with tracer.span('serve.forward', bucket=bucket):
+        emb = self._forward(bucket)(self.params, batch)
+        self.forward_calls += 1
+        rows = np.asarray(emb)[:n_valid]
     if self._out_dim is None:
       self._out_dim = int(rows.shape[1])
     return rows

@@ -1,68 +1,55 @@
 """Serving observability: QPS, latency percentiles, batch fill, cache
-hit-rate.
+hit-rate — a back-compat **view over the unified obs registry**.
 
-Built on the :mod:`glt_tpu.utils.profile` primitives — the QPS line is a
-ThroughputMeter (whose auto-scaled report keeps sub-million request
-rates readable) and wall-clock anchoring uses the same
-``time.perf_counter`` convention as profile.Timer. Latency percentiles
-come from a fixed-memory log-spaced histogram rather than a sample
-reservoir: p99 under heavy traffic must not depend on which requests
-survived sampling.
+Historically this module owned its own lock + raw counter fields. It is
+now a thin facade over :class:`glt_tpu.obs.MetricsRegistry`: every
+counter, gauge and the latency histogram live in a registry (a private
+one by default, or a shared one passed in), so serving, stream ingest,
+resilience, the distributed fabric and the training loaders all publish
+to ONE exposition surface (JSON / Prometheus text) while every existing
+call site — ``record_*``, attribute reads, ``snapshot()`` keys — keeps
+working unchanged.
+
+The registry's single lock also closes the torn-read bug class for the
+derived readings: ``qps`` / ``batch_fill_ratio`` / ``report()`` used to
+read ``requests`` / ``elapsed`` / the counters WITHOUT the lock (the
+same class of bug fixed for ``EmbeddingCache.hit_rate`` in PR 3); they
+now all derive from one locked :meth:`snapshot` cut.
 """
 from __future__ import annotations
 
-import math
-import threading
 import time
+from typing import Optional
 
+# LatencyHistogram moved to the obs layer (glt_tpu.obs.registry);
+# re-exported here for back-compat with existing imports
+from ..obs.registry import (  # noqa: F401
+    LatencyHistogram, MetricsRegistry,
+)
 from ..utils.profile import ThroughputMeter
 
+#: attribute name -> registry metric name. The attribute names (and the
+#: snapshot() keys derived from them) are frozen public API.
+_COUNTERS = {
+    'requests': 'serving_requests_total',
+    'ids_served': 'serving_ids_served_total',
+    'timeouts': 'serving_timeouts_total',
+    'rejected': 'serving_rejected_total',
+    'batches': 'serving_batches_total',
+    'batched_ids': 'serving_batched_ids_total',
+    'batch_capacity': 'serving_batch_capacity_total',
+    # failure/degradation counters (resilience fabric): every degraded
+    # answer and every recovery action is accounted here so a chaos run
+    # can assert that shed + served == submitted, nothing silently lost
+    'retries': 'rpc_retries_total',
+    'reconnects': 'rpc_reconnects_total',
+    'breaker_opens': 'rpc_breaker_opens_total',
+    'shed': 'serving_shed_total',
+    'stale_serves': 'serving_stale_serves_total',
+    'failovers': 'rpc_failovers_total',
+}
 
-class LatencyHistogram:
-  """Log-spaced latency histogram: fixed memory, ~5% relative bucket
-  error across 10 µs .. ~100 s."""
-
-  #: geometric bucket layout
-  _MIN = 1e-5
-  _GROWTH = 1.1
-
-  def __init__(self, num_bins: int = 170):
-    self._counts = [0] * (num_bins + 2)  # [under | bins | over]
-    self._num_bins = num_bins
-    self.count = 0
-    self.sum = 0.0
-    self.max = 0.0
-
-  def _bin(self, seconds: float) -> int:
-    if seconds < self._MIN:
-      return 0
-    b = int(math.log(seconds / self._MIN) / math.log(self._GROWTH)) + 1
-    return min(b, self._num_bins + 1)
-
-  def observe(self, seconds: float) -> None:
-    self._counts[self._bin(seconds)] += 1
-    self.count += 1
-    self.sum += seconds
-    self.max = max(self.max, seconds)
-
-  def percentile(self, q: float) -> float:
-    """q in [0, 100]; returns the upper edge of the bucket holding the
-    q-th request (0.0 when empty)."""
-    if self.count == 0:
-      return 0.0
-    target = math.ceil(self.count * q / 100.0)
-    seen = 0
-    for b, c in enumerate(self._counts):
-      seen += c
-      if seen >= target:
-        if b == 0:
-          return self._MIN
-        return min(self._MIN * self._GROWTH ** b, self.max)
-    return self.max
-
-  @property
-  def mean(self) -> float:
-    return self.sum / self.count if self.count else 0.0
+_LATENCY = 'serving_latency_seconds'
 
 
 class ServingMetrics:
@@ -70,93 +57,88 @@ class ServingMetrics:
 
   All record_* methods are thread-safe (the batcher dispatcher, RPC
   handler threads, and direct callers all write concurrently).
+
+  Args:
+    registry: publish into this :class:`MetricsRegistry` instead of a
+      fresh private one — pass :func:`glt_tpu.obs.get_registry` to land
+      these counters on the process-global exposition surface next to
+      the pipeline stage timings.
+    name: instance label attached to every instrument when sharing a
+      registry (two ServingMetrics on one registry must not collide);
+      empty = unlabeled.
   """
 
-  def __init__(self):
-    self._lock = threading.Lock()
-    self.latency = LatencyHistogram()
-    self.requests = 0
-    self.ids_served = 0
-    self.timeouts = 0
-    self.rejected = 0
-    self.batches = 0
-    self.batched_ids = 0
-    self.batch_capacity = 0
-    # failure/degradation counters (resilience fabric): every degraded
-    # answer and every recovery action is accounted here so a chaos run
-    # can assert that shed + served == submitted, nothing silently lost
-    self.retries = 0          # rpc attempts beyond the first
-    self.reconnects = 0       # transparent socket re-establishments
-    self.breaker_opens = 0    # CLOSED/HALF_OPEN -> OPEN transitions
-    self.shed = 0             # requests dropped BEFORE dispatch (deadline)
-    self.stale_serves = 0     # answers served from cache in degraded mode
-    self.failovers = 0        # lookups redirected to a replica partition
+  def __init__(self, registry: Optional[MetricsRegistry] = None,
+               name: str = ''):
+    self.registry = registry if registry is not None \
+        else MetricsRegistry()
+    self._labels = {'view': str(name)} if name else {}
+    self._c = {attr: self.registry.counter(metric, **self._labels)
+               for attr, metric in _COUNTERS.items()}
+    self.latency = self.registry.histogram(_LATENCY, **self._labels)
     # gauges: last-value-wins instruments for state (vs the monotonic
     # counters above) — snapshot version, delta occupancy, compaction
     # latency... The stream ingestor publishes here so serving and
     # streaming share ONE observability surface.
-    self._gauges: dict = {}
+    self._gauge_names: set = set()
     self._t0 = time.perf_counter()
 
+  # -- writers -----------------------------------------------------------
+
   def record_request(self, latency_s: float, num_ids: int = 1) -> None:
-    with self._lock:
+    with self.registry._lock:  # one atomic group, RLock-reentrant
       self.latency.observe(latency_s)
-      self.requests += 1
-      self.ids_served += int(num_ids)
+      self._c['requests'].inc()
+      self._c['ids_served'].inc(int(num_ids))
 
   def record_batch(self, num_ids: int, capacity: int) -> None:
-    with self._lock:
-      self.batches += 1
-      self.batched_ids += int(num_ids)
-      self.batch_capacity += int(capacity)
+    with self.registry._lock:
+      self._c['batches'].inc()
+      self._c['batched_ids'].inc(int(num_ids))
+      self._c['batch_capacity'].inc(int(capacity))
 
   def record_timeout(self) -> None:
-    with self._lock:
-      self.timeouts += 1
+    self._c['timeouts'].inc()
 
   def record_rejected(self) -> None:
-    with self._lock:
-      self.rejected += 1
+    self._c['rejected'].inc()
 
   def record_retry(self, n: int = 1) -> None:
-    with self._lock:
-      self.retries += int(n)
+    self._c['retries'].inc(int(n))
 
   def record_reconnect(self) -> None:
-    with self._lock:
-      self.reconnects += 1
+    self._c['reconnects'].inc()
 
   def record_breaker_open(self) -> None:
-    with self._lock:
-      self.breaker_opens += 1
+    self._c['breaker_opens'].inc()
 
   def record_shed(self, n: int = 1) -> None:
-    with self._lock:
-      self.shed += int(n)
+    self._c['shed'].inc(int(n))
 
   def record_stale_serve(self, n: int = 1) -> None:
-    with self._lock:
-      self.stale_serves += int(n)
+    self._c['stale_serves'].inc(int(n))
 
   def record_failover(self, n: int = 1) -> None:
-    with self._lock:
-      self.failovers += int(n)
+    self._c['failovers'].inc(int(n))
 
   def set_gauge(self, name: str, value: float) -> None:
-    with self._lock:
-      self._gauges[str(name)] = float(value)
+    with self.registry._lock:  # guards the name-set against snapshot()
+      self._gauge_names.add(str(name))
+      self.registry.set(str(name), float(value), **self._labels)
 
   def add_gauge(self, name: str, delta: float) -> float:
     """Atomic accumulate into a gauge (one lock hold — a
     get_gauge/set_gauge pair would tear under concurrent writers)."""
-    with self._lock:
-      v = self._gauges.get(str(name), 0.0) + float(delta)
-      self._gauges[str(name)] = v
-      return v
+    with self.registry._lock:
+      self._gauge_names.add(str(name))
+      return self.registry.add(str(name), float(delta), **self._labels)
 
   def get_gauge(self, name: str, default: float = 0.0) -> float:
-    with self._lock:
-      return self._gauges.get(name, default)
+    if name not in self._gauge_names:
+      return default
+    return self.registry.gauge(str(name), **self._labels).value
+
+  # -- readers -----------------------------------------------------------
 
   @property
   def elapsed(self) -> float:
@@ -164,53 +146,90 @@ class ServingMetrics:
 
   @property
   def qps(self) -> float:
-    return self.requests / max(self.elapsed, 1e-9)
+    # ONE locked cut of exactly the fields involved (the historical
+    # implementation read `requests` without the lock — the hit_rate
+    # torn-read bug class); cheaper than a full snapshot() for pollers
+    with self.registry._lock:
+      requests = self._c['requests']._value
+      elapsed = self.elapsed
+    return requests / max(elapsed, 1e-9)
 
   @property
   def batch_fill_ratio(self) -> float:
     """Mean fraction of the micro-batch capacity actually carrying
     requested ids (1.0 = every flush full)."""
-    return self.batched_ids / self.batch_capacity \
-        if self.batch_capacity else 0.0
+    with self.registry._lock:
+      ids = self._c['batched_ids']._value
+      cap = self._c['batch_capacity']._value
+    return ids / cap if cap else 0.0
 
   def snapshot(self, cache=None) -> dict:
-    with self._lock:
+    out, _ = self._snapshot(cache)
+    return out
+
+  def _snapshot(self, cache=None):
+    """(snapshot dict, elapsed) from ONE locked cut — ``elapsed`` rides
+    alongside (not as a key: the snapshot key set is frozen API) so
+    ``report()`` never pairs counters with a later clock read."""
+    with self.registry._lock:
+      elapsed = self.elapsed
+      c = {attr: int(ctr._value) for attr, ctr in self._c.items()}
+      # the registry RLock is held: histogram reads re-enter it
+      lat = self.latency
       out = {
-          'requests': self.requests,
-          'ids_served': self.ids_served,
-          'qps': self.qps,
-          'latency_p50_ms': self.latency.percentile(50) * 1e3,
-          'latency_p99_ms': self.latency.percentile(99) * 1e3,
-          'latency_mean_ms': self.latency.mean * 1e3,
-          'latency_max_ms': self.latency.max * 1e3,
-          'batches': self.batches,
-          'batch_fill_ratio': self.batch_fill_ratio,
-          'timeouts': self.timeouts,
-          'rejected': self.rejected,
+          'requests': c['requests'],
+          'ids_served': c['ids_served'],
+          'qps': c['requests'] / max(elapsed, 1e-9),
+          'latency_p50_ms': lat.percentile(50) * 1e3,
+          'latency_p99_ms': lat.percentile(99) * 1e3,
+          'latency_mean_ms': lat.mean * 1e3,
+          'latency_max_ms': lat.max * 1e3,
+          'batches': c['batches'],
+          'batch_fill_ratio': (c['batched_ids'] / c['batch_capacity']
+                               if c['batch_capacity'] else 0.0),
+          'timeouts': c['timeouts'],
+          'rejected': c['rejected'],
           # resilience counters: snapshotted under the SAME lock hold
           # as everything above — a reader can never see a torn pair
           # (e.g. a shed counted but its retry not yet) across fields
-          'retries': self.retries,
-          'reconnects': self.reconnects,
-          'breaker_opens': self.breaker_opens,
-          'shed': self.shed,
-          'stale_serves': self.stale_serves,
-          'failovers': self.failovers,
-          'gauges': dict(self._gauges),
+          'retries': c['retries'],
+          'reconnects': c['reconnects'],
+          'breaker_opens': c['breaker_opens'],
+          'shed': c['shed'],
+          'stale_serves': c['stale_serves'],
+          'failovers': c['failovers'],
+          'gauges': {
+              g: self.registry.gauge(g, **self._labels)._value
+              for g in sorted(self._gauge_names)
+          },
       }
     if cache is not None:
       out['cache'] = cache.stats()
       out['cache_hit_rate'] = out['cache']['hit_rate']
-    return out
+    return out, elapsed
 
   def report(self, cache=None) -> str:
-    """One-line human summary (ThroughputMeter formats the rate)."""
-    snap = self.snapshot(cache)
+    """One-line human summary (ThroughputMeter formats the rate) —
+    every field derives from one locked snapshot cut."""
+    snap, elapsed = self._snapshot(cache)
     meter = ThroughputMeter('req')
-    meter.update(self.requests, max(self.elapsed, 1e-9))
+    meter.update(snap['requests'], max(elapsed, 1e-9))
     line = (f'{meter.report()} p50={snap["latency_p50_ms"]:.2f}ms '
             f'p99={snap["latency_p99_ms"]:.2f}ms '
             f'fill={snap["batch_fill_ratio"]:.2f}')
     if cache is not None:
       line += f' cache_hit={snap["cache_hit_rate"]:.2f}'
     return line
+
+
+def _make_counter_property(attr: str):
+  def fget(self) -> int:
+    return int(self._c[attr].value)
+  fget.__name__ = attr
+  fget.__doc__ = f'Back-compat read of the {_COUNTERS[attr]} counter.'
+  return property(fget)
+
+
+for _attr in _COUNTERS:
+  setattr(ServingMetrics, _attr, _make_counter_property(_attr))
+del _attr

@@ -52,14 +52,20 @@ class ServingServer:
                request_timeout_ms: Optional[float] = 1000.0,
                warmup: bool = True,
                stall_timeout_ms: Optional[float] = None,
-               stale_serve: bool = False):
+               stale_serve: bool = False,
+               registry=None, metrics_name: str = ''):
     self.engine = engine
     self.stale_serve = bool(stale_serve)
     if warmup:
       engine.warmup()
     # metrics clock starts AFTER warmup: bucket compilation (tens of
-    # seconds on real models) must not dilute the reported QPS
-    self.metrics = ServingMetrics()
+    # seconds on real models) must not dilute the reported QPS.
+    # ``registry``: publish the serving counters into a shared
+    # MetricsRegistry (e.g. glt_tpu.obs.get_registry()) so one
+    # exposition surface carries serving + pipeline-stage metrics;
+    # ``metrics_name`` labels this server's series there — REQUIRED to
+    # keep two servers on one registry from merging their counters.
+    self.metrics = ServingMetrics(registry=registry, name=metrics_name)
     self.batcher = MicroBatcher(
         engine.infer,
         max_batch_size=max_batch_size or engine.buckets[-1],
@@ -83,6 +89,14 @@ class ServingServer:
   # -- callees (also the in-process API) ---------------------------------
 
   def infer(self, ids, timeout_ms: Optional[float] = None) -> np.ndarray:
+    from ..obs import get_tracer
+    tracer = get_tracer()
+    if not tracer.enabled:  # span kwargs would pay an asarray per call
+      return self._infer(ids, timeout_ms)
+    with tracer.span('serve.infer', ids=int(np.asarray(ids).size)):
+      return self._infer(ids, timeout_ms)
+
+  def _infer(self, ids, timeout_ms: Optional[float] = None) -> np.ndarray:
     t = Timer().start()
     # validate BEFORE batching: a bad id raised inside the dispatcher
     # would fail every co-batched request, not just this caller's

@@ -29,6 +29,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..obs import get_tracer
 from ..serving.metrics import ServingMetrics
 from ..utils.profile import Timer
 from .delta import EdgeDeltaBuffer, FeatureDeltaBuffer
@@ -226,9 +227,11 @@ class StreamIngestor:
       t = Timer().start()
       edge_cut = feat_cut = None
       try:
-        edge_cut = self.edges.drain()
-        feat_cut = self.features.drain() if self.features else None
-        snap, info = self.manager.compact(edge_cut, feat_cut)
+        with get_tracer().span('stream.compact',
+                               pending=self.edges.size):
+          edge_cut = self.edges.drain()
+          feat_cut = self.features.drain() if self.features else None
+          snap, info = self.manager.compact(edge_cut, feat_cut)
       except Exception:
         # failed anywhere past the first drain: put whatever was
         # drained back so no update is lost

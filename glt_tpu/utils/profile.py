@@ -16,7 +16,12 @@ import jax
 
 
 class Timer:
-  """Wall-clock timer that synchronizes outstanding device work."""
+  """Wall-clock timer that synchronizes outstanding device work.
+
+  ``elapsed`` accumulates across start/stop intervals; each ``stop()``
+  consumes the matching ``start()``, so a stop without a running
+  interval raises a clear RuntimeError instead of the historical
+  ``TypeError: unsupported operand`` on the ``None`` start stamp."""
 
   def __init__(self):
     self.reset()
@@ -25,21 +30,34 @@ class Timer:
     self._t0 = None
     self.elapsed = 0.0
 
+  @property
+  def running(self) -> bool:
+    return self._t0 is not None
+
   def start(self):
+    # re-entrant start (incl. reusing one Timer across `with` blocks)
+    # cleanly restarts the interval stamp; accumulated elapsed stays
     self._t0 = time.perf_counter()
     return self
 
   def stop(self, sync: Optional[jax.Array] = None) -> float:
+    if self._t0 is None:
+      raise RuntimeError(
+          'Timer.stop() without a running interval: call start() (or '
+          'enter the context manager) first; each stop() consumes its '
+          'start()')
     if sync is not None:
       jax.block_until_ready(sync)
     self.elapsed += time.perf_counter() - self._t0
+    self._t0 = None
     return self.elapsed
 
   def __enter__(self):
     return self.start()
 
   def __exit__(self, *exc):
-    self.stop()
+    if self._t0 is not None:  # tolerate an explicit stop() in the body
+      self.stop()
 
 
 class ThroughputMeter:

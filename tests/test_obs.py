@@ -1,0 +1,512 @@
+"""Unified observability layer: metrics registry, tracer, RPC trace
+propagation, back-compat of the ServingMetrics view, and the
+obs-disabled overhead bound.
+
+The cross-process acceptance test (client + 2 partition servers
+assembling ONE Chrome trace) lives at the bottom — it reuses the
+test_server_client spawn harness."""
+import json
+import multiprocessing as mp
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from glt_tpu.obs import (
+    LatencyHistogram, MetricsRegistry, Tracer, collect_endpoint_obs,
+    get_tracer, merge_chrome_traces,
+)
+from glt_tpu.serving import ServingMetrics
+
+
+@pytest.fixture
+def tracer():
+  """The process tracer, force-restored to disabled+empty."""
+  t = get_tracer()
+  was, sample = t.enabled, t._sample
+  t.clear()
+  yield t
+  t.enabled, t._sample = was, sample
+  t.clear()
+
+
+# -- registry ------------------------------------------------------------
+
+def test_registry_counter_gauge_histogram():
+  r = MetricsRegistry()
+  c = r.counter('requests_total')
+  assert c.inc() == 1 and c.inc(4) == 5
+  assert r.counter('requests_total') is c  # get-or-create
+  r.set('depth', 3.0)
+  assert r.add('depth', -1.0) == 2.0
+  assert r.get('depth') == 2.0
+  assert r.get('missing', default=7.0) == 7.0
+  r.observe('lat_seconds', 0.01)
+  r.observe('lat_seconds', 0.02)
+  snap = r.snapshot()
+  assert snap['counters']['requests_total'] == 5
+  assert snap['gauges']['depth'] == 2.0
+  h = snap['histograms']['lat_seconds']
+  assert h['count'] == 2 and abs(h['sum'] - 0.03) < 1e-9
+  assert 0 < h['p50'] <= h['p99'] <= h['max'] + 1e-9
+  json.loads(r.to_json())  # exposition is valid JSON
+
+
+def test_registry_labels_distinct_series():
+  r = MetricsRegistry()
+  r.inc('hits', stage='sample')
+  r.inc('hits', 2, stage='gather')
+  snap = r.snapshot()['counters']
+  assert snap['hits{stage="sample"}'] == 1
+  assert snap['hits{stage="gather"}'] == 2
+  assert r.get('hits', stage='sample') == 1
+
+
+def test_registry_prometheus_exposition():
+  r = MetricsRegistry(namespace='glt')
+  r.inc('serving_requests_total', 3)
+  r.set('queue_depth', 4.0, shard='0')
+  r.observe('stage_seconds', 0.05, stage='gather')
+  text = r.to_prometheus()
+  assert '# TYPE glt_serving_requests_total counter' in text
+  assert 'glt_serving_requests_total 3' in text
+  assert 'glt_queue_depth{shard="0"} 4' in text
+  assert '# TYPE glt_stage_seconds summary' in text
+  assert 'glt_stage_seconds_count{stage="gather"} 1' in text
+  assert 'quantile="0.99"' in text
+
+
+def test_registry_snapshot_is_atomic_under_writers():
+  """Paired counters incremented under one registry-lock hold must
+  never tear in a concurrent snapshot (the hit_rate bug class)."""
+  r = MetricsRegistry()
+  a, b = r.counter('a_total'), r.counter('b_total')
+  stop = threading.Event()
+  bad = []
+
+  def writer():
+    for _ in range(2000):
+      with r._lock:  # one atomic group, as ServingMetrics writes them
+        a.inc()
+        b.inc()
+
+  def reader():
+    while not stop.is_set():
+      s = r.snapshot()['counters']
+      if s['a_total'] != s['b_total']:
+        bad.append(s)
+
+  a.inc(0); b.inc(0)  # materialize before readers start
+  ts = [threading.Thread(target=writer) for _ in range(4)]
+  rd = threading.Thread(target=reader)
+  rd.start()
+  for t in ts:
+    t.start()
+  for t in ts:
+    t.join()
+  stop.set()
+  rd.join()
+  assert not bad, bad[:3]
+  assert a.value == b.value == 8000
+
+
+# -- LatencyHistogram edge cases (satellite) -----------------------------
+
+def test_histogram_percentile_edges():
+  h = LatencyHistogram()
+  assert h.percentile(0) == 0.0 and h.percentile(100) == 0.0  # empty
+  assert h.mean == 0.0
+  for ms in (1, 2, 5, 10):
+    h.observe(ms / 1e3)
+  # q=0 answers the underflow edge (a lower bound), q=100 the true max
+  assert h.percentile(0) == h._MIN
+  assert h.percentile(100) == h.max == 0.010
+
+
+def test_histogram_underflow_overflow_buckets():
+  h = LatencyHistogram(num_bins=10)
+  h.observe(1e-7)   # under the 10 µs floor -> underflow bucket
+  assert h._counts[0] == 1
+  assert h.percentile(50) == h._MIN
+  h2 = LatencyHistogram(num_bins=10)
+  h2.observe(1e9)   # absurdly past the top bucket -> overflow bucket
+  assert h2._counts[-1] == 1
+  # the overflow bucket's answer is clamped to the tracked true max
+  assert h2.percentile(99) == 1e9 == h2.max
+  assert h2.count == 1 and h2.sum == 1e9
+
+
+def test_add_gauge_concurrent_writers():
+  """add_gauge is one lock hold — N threads accumulating must land on
+  the exact total (a get/set pair would tear)."""
+  m = ServingMetrics()
+  N, W = 1000, 8
+
+  def worker():
+    for _ in range(N):
+      m.add_gauge('acc', 1.0)
+
+  ts = [threading.Thread(target=worker) for _ in range(W)]
+  for t in ts:
+    t.start()
+  for t in ts:
+    t.join()
+  assert m.get_gauge('acc') == float(N * W)
+
+
+# -- ServingMetrics as a registry view (back-compat) ---------------------
+
+#: the frozen pre-obs snapshot() key set — the back-compat contract
+_LEGACY_SNAPSHOT_KEYS = {
+    'requests', 'ids_served', 'qps', 'latency_p50_ms', 'latency_p99_ms',
+    'latency_mean_ms', 'latency_max_ms', 'batches', 'batch_fill_ratio',
+    'timeouts', 'rejected', 'retries', 'reconnects', 'breaker_opens',
+    'shed', 'stale_serves', 'failovers', 'gauges',
+}
+
+
+def test_serving_metrics_snapshot_keys_unchanged():
+  m = ServingMetrics()
+  assert set(m.snapshot().keys()) == _LEGACY_SNAPSHOT_KEYS
+  # every legacy counter attribute still reads as an int
+  for attr in ('requests', 'ids_served', 'timeouts', 'rejected',
+               'batches', 'batched_ids', 'batch_capacity', 'retries',
+               'reconnects', 'breaker_opens', 'shed', 'stale_serves',
+               'failovers'):
+    assert getattr(m, attr) == 0
+
+
+def test_serving_metrics_exposed_in_registry():
+  """The view publishes into ONE registry: every legacy counter appears
+  in the registry's Prometheus exposition."""
+  m = ServingMetrics()
+  m.record_request(0.003, num_ids=2)
+  m.record_retry(3)
+  m.set_gauge('snapshot_version', 5)
+  text = m.registry.to_prometheus()
+  assert 'glt_serving_requests_total 1' in text
+  assert 'glt_serving_ids_served_total 2' in text
+  assert 'glt_rpc_retries_total 3' in text
+  assert 'glt_snapshot_version 5' in text
+  assert 'glt_serving_latency_seconds_count 1' in text
+
+
+def test_serving_metrics_shared_registry_with_labels():
+  r = MetricsRegistry()
+  m1 = ServingMetrics(registry=r, name='a')
+  m2 = ServingMetrics(registry=r, name='b')
+  m1.record_request(0.001)
+  m1.record_request(0.001)
+  m2.record_request(0.001)
+  assert m1.requests == 2 and m2.requests == 1  # no collision
+  counters = r.snapshot()['counters']
+  assert counters['serving_requests_total{view="a"}'] == 2
+  assert counters['serving_requests_total{view="b"}'] == 1
+
+
+def test_qps_and_fill_ratio_derive_from_locked_snapshot():
+  """The satellite fix: qps / batch_fill_ratio / report() route through
+  one locked snapshot instead of raw unlocked field reads."""
+  m = ServingMetrics()
+  for _ in range(10):
+    m.record_request(0.001)
+  m.record_batch(6, 8)
+  assert m.qps > 0
+  assert m.batch_fill_ratio == 0.75
+  rep = m.report()
+  assert 'p50=' in rep and 'fill=0.75' in rep
+  # hammer writers while reading the derived properties: no exceptions,
+  # values always internally consistent
+  done = threading.Event()
+
+  def writer():
+    while not done.is_set():
+      m.record_batch(1, 2)
+
+  t = threading.Thread(target=writer)
+  t.start()
+  try:
+    for _ in range(200):
+      assert 0.0 <= m.batch_fill_ratio <= 1.0
+      assert m.qps >= 0.0
+  finally:
+    done.set()
+    t.join()
+
+
+# -- tracer --------------------------------------------------------------
+
+def test_tracer_disabled_is_noop(tracer):
+  assert not tracer.enabled
+  cm = tracer.span('x')
+  assert tracer.span('y') is cm  # the cached null manager
+  with cm as ctx:
+    assert ctx is None
+  assert tracer.events() == []
+
+
+def test_tracer_nesting_and_chrome_export(tracer):
+  tracer.enable()
+  with tracer.span('root', cat='test') as root:
+    with tracer.span('child') as child:
+      assert child.trace_id == root.trace_id
+      assert tracer.current_context() == child
+    with tracer.span('child2'):
+      pass
+  evs = tracer.events(trace_id=root.trace_id)
+  assert [e['name'] for e in evs] == ['child', 'child2', 'root']
+  by_name = {e['name']: e for e in evs}
+  assert by_name['child']['args']['parent_id'] == root.span_id
+  assert by_name['child2']['args']['parent_id'] == root.span_id
+  assert 'parent_id' not in by_name['root']['args']
+  doc = merge_chrome_traces(evs)
+  json.dumps(doc)  # Chrome/Perfetto-loadable
+  assert any(e.get('ph') == 'M' for e in doc['traceEvents'])
+  assert all(e['ph'] == 'X' and e['dur'] >= 0
+             for e in doc['traceEvents'] if e.get('ph') != 'M')
+
+
+def test_tracer_remote_span_reopens_context(tracer):
+  """The server side of RPC propagation: an incoming (trace_id,
+  span_id) pair becomes the parent, even with the local tracer
+  disabled (the CALLER opted into tracing)."""
+  assert not tracer.enabled
+  with tracer.remote_span('rpc.server:f', ('t1234', 'c9')):
+    pass
+  (ev,) = tracer.events()
+  assert ev['args']['trace_id'] == 't1234'
+  assert ev['args']['parent_id'] == 'c9'
+
+
+def test_tracer_sync_callable_and_sampling(tracer):
+  import jax.numpy as jnp
+  tracer.enable(sample=1.0)
+  holder = {}
+  with tracer.span('dispatch', sync=lambda: holder.get('x')):
+    holder['x'] = jnp.arange(8) * 2
+  (ev,) = tracer.events()
+  assert ev['args'].get('synced') is True
+  tracer.clear()
+  tracer.enable(sample=0.0)  # sampling off: no sync marker
+  with tracer.span('dispatch', sync=lambda: holder['x']):
+    pass
+  (ev,) = tracer.events()
+  assert 'synced' not in ev['args']
+
+
+def test_tracer_ring_buffer_bounds(tracer):
+  t = Tracer(enabled=True, buffer=16, registry=MetricsRegistry())
+  for i in range(40):
+    with t.span(f's{i}'):
+      pass
+  assert len(t.events()) == 16
+  assert t.dropped == 24
+
+
+def test_tracer_publishes_stage_histograms(tracer):
+  reg = MetricsRegistry()
+  t = Tracer(enabled=True, registry=reg)
+  for _ in range(3):
+    with t.span('gather.features'):
+      pass
+  snap = reg.snapshot()['histograms']
+  assert snap['stage_seconds{stage="gather.features"}']['count'] == 3
+
+
+# -- RPC propagation (single process, real sockets) ----------------------
+
+def test_rpc_trace_propagation_and_obs_harvest(tracer):
+  from glt_tpu.distributed.rpc import RpcClient, RpcServer
+  srv = RpcServer()
+  srv.register('mul', lambda a, b: a * b)
+  cli = RpcClient(srv.host, srv.port)
+  try:
+    # untraced request: no spans recorded anywhere
+    assert cli.request('mul', 3, 4) == 12
+    assert tracer.events() == []
+    tracer.enable()
+    with tracer.span('root') as root:
+      assert cli.request('mul', 5, 6) == 30
+    tracer.disable()
+    evs = tracer.events(trace_id=root.trace_id)
+    names = sorted(e['name'] for e in evs)
+    assert names == ['root', 'rpc.client:mul', 'rpc.server:mul']
+    by = {e['name']: e for e in evs}
+    assert by['rpc.client:mul']['args']['parent_id'] == root.span_id
+    assert by['rpc.server:mul']['args']['parent_id'] == \
+        by['rpc.client:mul']['args']['span_id']
+    # the built-in _obs callee harvests the same events + registry
+    out = collect_endpoint_obs(srv.host, srv.port)
+    assert {e['name'] for e in out['events']} >= {'rpc.server:mul'}
+    assert 'counters' in out['metrics']
+  finally:
+    cli.close()
+    srv.stop()
+
+
+# -- zero-recompile invariants hold with obs enabled ---------------------
+
+def test_engine_zero_recompiles_with_obs_enabled(tracer):
+  """Tracing (incl. 100% device-sync sampling) is host-side only: the
+  serving engine's steady state must stay at zero re-traces."""
+  import jax
+  from fixtures import ring_dataset
+  from glt_tpu.models import GraphSAGE
+  from glt_tpu.serving import InferenceEngine
+  ds = ring_dataset(num_nodes=40)
+  model = GraphSAGE(hidden_features=8, out_features=4, num_layers=2)
+  eng = InferenceEngine(ds, model, None, [-1, -1], buckets=(4, 8))
+  eng.init_params(jax.random.key(0))
+  eng.warmup()
+  warm = eng.compile_stats()
+  tracer.enable(sample=1.0)
+  for n in (1, 3, 4, 7, 8):
+    eng.infer(np.arange(n) % 40)
+  now = eng.compile_stats()
+  assert now['forward_traces'] == warm['forward_traces']
+  assert now['sampler_compiled_fns'] == warm['sampler_compiled_fns']
+  # and the stages actually traced
+  names = {e['name'] for e in tracer.events()}
+  assert {'serve.bucket', 'serve.forward', 'sample.multihop',
+          'gather.features'} <= names
+
+
+# -- obs-disabled overhead bound (satellite: tier-1 guard) ---------------
+
+def test_obs_disabled_overhead_under_2_percent(tracer):
+  """The no-op path (disabled tracer span + enabled-check) must cost
+  under 2% of a sampled-epoch microbenchmark. Measured structurally:
+  time one real sampled epoch, then time the no-op obs calls that
+  epoch would issue, scaled up 4x for margin."""
+  from fixtures import ring_dataset
+  from glt_tpu.loader import NeighborLoader
+  assert not tracer.enabled
+  ds = ring_dataset(num_nodes=200)
+  loader = NeighborLoader(ds, [4, 4], np.arange(200), batch_size=32,
+                          seed=0)
+  list(loader)  # compile outside the timed window
+  epoch_s = min(_timed(lambda: list(loader)) for _ in range(3))
+  n_batches = len(loader)
+  # spans issued per batch on this path: loader.batch enabled-check,
+  # sample.multihop, gather.features (+ slack for future stages)
+  spans_per_batch = 8
+
+  def noop_spans():
+    for _ in range(n_batches * spans_per_batch * 4):
+      with tracer.span('loader.batch', batch=32):
+        pass
+
+  noop_s = min(_timed(noop_spans) for _ in range(3)) / 4
+  assert noop_s < 0.02 * epoch_s, (
+      f'no-op obs path costs {noop_s * 1e3:.3f}ms against a '
+      f'{epoch_s * 1e3:.1f}ms epoch (>{noop_s / epoch_s:.1%})')
+
+
+def _timed(fn):
+  t0 = time.perf_counter()
+  fn()
+  return time.perf_counter() - t0
+
+
+# -- cross-process acceptance: one trace across client + 2 servers -------
+
+def _obs_server_proc(rank, port, ready, done):
+  import os
+  import sys
+  sys.path.insert(0, os.path.dirname(__file__))
+  from glt_tpu.utils.backend import force_backend
+  force_backend('cpu')
+  from glt_tpu.obs import get_tracer
+  get_tracer().enable()
+  from fixtures import ring_dataset
+  from glt_tpu.distributed import init_server, wait_and_shutdown_server
+  ds = ring_dataset(num_nodes=40, feat_dim=4)
+  init_server(num_servers=2, num_clients=1, server_rank=rank,
+              dataset=ds, master_port=port)
+  ready.set()
+  wait_and_shutdown_server(poll_s=0.1)
+  done.set()
+
+
+def test_distributed_trace_single_trace_id(tmp_path, tracer):
+  """Client + 2 partition servers: a sample-and-serve run must emit ONE
+  Chrome-trace JSON where the client-side spans and the server-side
+  handler spans share one trace id and nest correctly (deterministic
+  span tree)."""
+  from fixtures import ring_dataset
+  from glt_tpu.channel import pack_message
+  from glt_tpu.distributed import (
+      export_fabric_trace, init_client, request_server, shutdown_client,
+  )
+  from glt_tpu.sampler import NeighborSampler
+  ctx = mp.get_context('spawn')
+  port = 47321
+  readies = [ctx.Event() for _ in range(2)]
+  dones = [ctx.Event() for _ in range(2)]
+  servers = [ctx.Process(target=_obs_server_proc,
+                         args=(r, port, readies[r], dones[r]))
+             for r in range(2)]
+  for s in servers:
+    s.start()
+  for e in readies:
+    assert e.wait(timeout=60), 'server did not come up'
+
+  init_client(num_servers=2, num_clients=1, client_rank=0,
+              master_port=port, health_interval_s=None)
+  try:
+    tracer.enable()
+    local_sampler = NeighborSampler(
+        ring_dataset(num_nodes=40).graph, [2, 2], seed=0)
+    with tracer.span('pipeline.request') as root:
+      # sample arm: local multihop (the client-side sampling stage)
+      local_sampler.sample_from_nodes(np.arange(8))
+      # serve arm: remote feature lookups on BOTH partition servers
+      for s in (0, 1):
+        request_server(s, 'get_node_feature',
+                       pack_message({'ids': np.array([1, 2, 3])}))
+    tracer.disable()
+
+    # -- deterministic span-tree assertions ------------------------------
+    client_evs = tracer.events(trace_id=root.trace_id)
+    names = sorted(e['name'] for e in client_evs)
+    assert names == ['pipeline.request', 'rpc.client:get_node_feature',
+                     'rpc.client:get_node_feature', 'sample.multihop']
+    by_id = {e['args']['span_id']: e for e in client_evs}
+    rpc_spans = [e for e in client_evs
+                 if e['name'] == 'rpc.client:get_node_feature']
+    for e in client_evs:
+      if e['name'] != 'pipeline.request':
+        assert e['args']['parent_id'] == root.span_id
+
+    from glt_tpu.distributed import collect_obs
+    server_parents = []
+    for s in (0, 1):
+      sev = [e for e in collect_obs(s)['events']
+             if e['args'].get('trace_id') == root.trace_id]
+      assert [e['name'] for e in sev] == \
+          ['rpc.server:get_node_feature'], sev
+      assert sev[0]['pid'] != client_evs[0]['pid']  # truly cross-process
+      server_parents.append(sev[0]['args']['parent_id'])
+    # each handler span nests under exactly one distinct client rpc span
+    assert sorted(server_parents) == \
+        sorted(e['args']['span_id'] for e in rpc_spans)
+    assert set(server_parents) <= set(by_id)
+
+    # -- single merged Perfetto/Chrome JSON ------------------------------
+    path = str(tmp_path / 'fabric_trace.json')
+    export_fabric_trace(path, trace_id=root.trace_id)
+    doc = json.load(open(path))
+    spans = [e for e in doc['traceEvents'] if e.get('ph') == 'X']
+    assert len(spans) == 6  # 4 client + 2 server handler spans
+    assert {e['args']['trace_id'] for e in spans} == {root.trace_id}
+    assert len({e['pid'] for e in spans}) == 3  # client + 2 servers
+  finally:
+    shutdown_client()
+    # drop the client DistContext so later tests (e.g. test_rpc_fabric's
+    # no-context identity check) see a clean slate
+    from glt_tpu.distributed.dist_context import shutdown
+    shutdown()
+  for i, s in enumerate(servers):
+    assert dones[i].wait(timeout=30), 'server did not exit cleanly'
+    s.join(timeout=10)

@@ -83,6 +83,40 @@ def test_timer_and_meter():
   assert 'edges/s' in m.report()
 
 
+def test_timer_stop_without_start_raises():
+  """Historically crashed with `TypeError: unsupported operand` on the
+  None start stamp; now a clear RuntimeError."""
+  import pytest
+  t = Timer()
+  with pytest.raises(RuntimeError, match='without a running interval'):
+    t.stop()
+  # stop() consumes its start(): a second stop is the same clear error
+  t.start()
+  t.stop()
+  with pytest.raises(RuntimeError, match='without a running interval'):
+    t.stop()
+
+
+def test_timer_reentrant_enter_resets_cleanly():
+  t = Timer()
+  with t:
+    time.sleep(0.002)
+  first = t.elapsed
+  assert not t.running
+  with t:  # reuse: restarts the interval, keeps accumulating
+    time.sleep(0.002)
+  assert t.elapsed >= first + 0.002
+  # an explicit stop() inside the body is tolerated by __exit__
+  with t:
+    t.stop()
+  assert not t.running
+  # back-to-back start() calls restart the stamp instead of corrupting
+  t.reset()
+  t.start()
+  t.start()
+  assert t.stop() < 10.0  # one interval's worth, not garbage
+
+
 def test_meter_report_auto_scales_unit():
   """Sub-million rates used to print '0.00M edges/s' (hard-coded /1e6);
   the unit now auto-scales across raw / K / M."""
