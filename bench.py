@@ -100,24 +100,37 @@ def run_worker():
   def resolved_hop_engine():
     """The hop engine the current env ACTUALLY selects (post-fallback:
     GLT_HOP_ENGINE=pallas without an importable pallas resolves to
-    'window') — both the hop closure and the engines{} labels read
-    this, so the recorded label never claims an engine that didn't
-    run. Legacy GLT_WINDOW_HOP=1 maps to 'window'."""
-    from glt_tpu.ops.pipeline import hop_engine
+    'window'; pallas_fused whose dedup table would blow the VMEM knob
+    resolves to 'pallas') — both the hop closure and the engines{}
+    labels read this, so the recorded label never claims an engine that
+    didn't run. Legacy GLT_WINDOW_HOP=1 maps to 'window'."""
+    from glt_tpu.ops.pipeline import hop_engine, sample_budget
     if 'GLT_HOP_ENGINE' in os.environ:
-      return hop_engine()
+      eng = hop_engine()
+      if eng == 'pallas_fused':
+        from glt_tpu.ops.pallas_kernels import (fused_table_max_slots,
+                                                fused_table_slots)
+        if fused_table_slots(sample_budget(BATCH, list(FANOUT))) \
+            > fused_table_max_slots():
+          from glt_tpu.ops.pipeline import count_engine_fallback
+          count_engine_fallback('pallas_fused', 'pallas',
+                                'table_overflow')
+          return 'pallas'
+      return eng
     if os.environ.get('GLT_WINDOW_HOP', '0') in ('1', 'true'):
       return 'window'
     return 'element'
 
   def make_one_hop():
-    """Build the hop closure under the CURRENT env. The W-padded
-    indices copy and the true hub count are built once and shared
-    across engine passes."""
+    """Build (hop closure, fused plan) under the CURRENT env. The
+    W-padded indices copy and the true hub count are built once and
+    shared across engine passes; the fused plan routes multihop_sample
+    through the pallas_fused kernel family (the hop closure is then
+    unused but kept so every engine shares one call shape)."""
     eng = resolved_hop_engine()
     if eng == 'element':
-      return lambda ids, fanout, key, mask: sample_neighbors(
-          indptr, indices, ids, fanout, key, seed_mask=mask)
+      return (lambda ids, fanout, key, mask: sample_neighbors(
+          indptr, indices, ids, fanout, key, seed_mask=mask)), None
     win_w = int(os.environ.get('GLT_WINDOW_W', '96'))
     if win_state.get('w') != win_w:
       # hub capacity from the graph's true hub count (host, once) so
@@ -130,13 +143,25 @@ def run_worker():
     print(f'# hop engine: {eng} W={win_w} n_hub={n_hub}',
           file=sys.stderr)
     interp = False
-    if eng == 'pallas':
+    if eng in ('pallas', 'pallas_fused'):
       from glt_tpu.ops.pallas_kernels import interpret_default
       interp = interpret_default()
-    return lambda ids, fanout, key, mask: sample_neighbors(
+    if eng == 'pallas_fused':
+      from glt_tpu.ops.pallas_kernels import fused_table_slots
+      from glt_tpu.ops.pipeline import sample_budget
+      from glt_tpu.ops.sample import FusedHopPlan
+      plan = FusedHopPlan(
+          indptr, indices, iw, win_w, n_hub,
+          fused_table_slots(sample_budget(BATCH, list(FANOUT))),
+          interpret=interp)
+      return (lambda ids, fanout, key, mask: sample_neighbors(
+          indptr, indices, ids, fanout, key, seed_mask=mask,
+          window=(win_w, min(n_hub, ids.shape[0])), indices_win=iw,
+          engine='pallas', interpret=interp)), plan
+    return (lambda ids, fanout, key, mask: sample_neighbors(
         indptr, indices, ids, fanout, key, seed_mask=mask,
         window=(win_w, min(n_hub, ids.shape[0])), indices_win=iw,
-        engine=eng, interpret=interp)
+        engine=eng, interpret=interp)), None
 
   import functools
   scan = max(int(os.environ.get('GLT_BENCH_SCAN', '4')), 1)
@@ -153,7 +178,7 @@ def run_worker():
     compile/trace wall-time of the first dispatch, and the number of
     re-traces observed during the timed loop (must be 0 — any recompile
     in steady state is a shape-stability bug)."""
-    one_hop = make_one_hop()
+    one_hop, fused_plan = make_one_hop()
     traces = {'n': 0}
 
     @functools.partial(jax.jit, donate_argnums=(2, 3))
@@ -163,12 +188,12 @@ def run_worker():
         from glt_tpu.ops.pipeline import multihop_sample_many
         outs, table, scratch = multihop_sample_many(
             one_hop, seeds, jnp.full(scan, BATCH, jnp.int32), FANOUT,
-            key, table, scratch)
+            key, table, scratch, fused_plan=fused_plan)
         return (outs['num_sampled_edges'].sum(), checksum(outs), table,
                 scratch)
       out, table, scratch = multihop_sample(
           one_hop, seeds[0], jnp.asarray(BATCH), FANOUT, key, table,
-          scratch)
+          scratch, fused_plan=fused_plan)
       return (out['num_sampled_edges'].sum(), checksum(out), table,
               scratch)
 
@@ -224,6 +249,8 @@ def run_worker():
   res = engines[base_label] = measure()
   eps = res['edges_per_sec']
   first_cost = time.time() - t_start
+  engine_envs = {base_label: {}}  # per-contender env, for the
+                                  # per-engine stage-breakdown pass
 
   def room_for_another():
     return (not worker_budget
@@ -235,6 +262,7 @@ def run_worker():
     os.environ.update(env)
     try:
       engines[label] = measure()
+      engine_envs[label] = dict(env)
     except Exception as e:  # keep the measured headline on any failure
       engines[label + '_error'] = str(e)[:200]
     finally:
@@ -245,8 +273,12 @@ def run_worker():
           os.environ[k] = v
 
   if (dedup_engine() == 'sort' and not fused_hops()
-      and 'GLT_FUSED_HOP' not in os.environ and room_for_another()):
-    race('sort+fused', {'GLT_FUSED_HOP': '1'})
+      and 'GLT_FUSED_HOP' not in os.environ
+      and resolved_hop_engine() != 'pallas_fused'  # knob is inert there
+      and room_for_another()):
+    # hop_suffix() rides along: under a forced hop engine the raced
+    # pass still runs that engine, and the label must say so
+    race('sort+fused' + hop_suffix(), {'GLT_FUSED_HOP': '1'})
   if ('GLT_HOP_ENGINE' not in os.environ
       and os.environ.get('GLT_WINDOW_HOP', '0') not in ('1', 'true')
       and dev.platform == 'tpu' and room_for_another()):
@@ -265,6 +297,21 @@ def run_worker():
                + '+pallas')
       race(label, {'GLT_HOP_ENGINE': 'pallas',
                    'GLT_FUSED_HOP': '1' if ride_fused else '0'})
+      from glt_tpu.ops.pallas_kernels import (fused_table_max_slots,
+                                              fused_table_slots)
+      from glt_tpu.ops.pipeline import sample_budget
+      fused_fits = (fused_table_slots(sample_budget(BATCH, list(FANOUT)))
+                    <= fused_table_max_slots())
+      if fused_fits and room_for_another():
+        # the fully-fused pipeline: sample + dedup in one kernel, the
+        # sort+fused label contract implemented in VMEM
+        race('sort+pallas_fused', {'GLT_HOP_ENGINE': 'pallas_fused',
+                                   'GLT_FUSED_HOP': '1'})
+      elif not fused_fits:
+        # racing a demoted engine would just re-measure pallas under a
+        # misleading label; record the reason instead
+        engines['sort+pallas_fused_skipped'] = (
+            'dedup table exceeds GLT_FUSED_TABLE_SLOTS at this batch')
   best = max((v['edges_per_sec'], k) for k, v in engines.items()
              if isinstance(v, dict))
   eps, chosen = best
@@ -302,7 +349,11 @@ def run_worker():
   # protocol independent of the headline knobs; budget-guarded, never
   # fatal. GLT_OBS_DUMP=<dir> additionally writes the registry snapshot
   # and a Perfetto-loadable trace JSON there (the CI smoke-bench
-  # artifacts).
+  # artifacts). Each raced contender additionally gets its OWN
+  # breakdown (same protocol, smaller batch so the fused engine's
+  # dedup table engages at smoke scale) so a fusion delta in the
+  # headline is attributable stage-by-stage: the fused engine should
+  # show gather.features self-time collapsing into sample.multihop.
   stage_breakdown = None
   if os.environ.get('GLT_BENCH_OBS', '1') != '0':
     spent = time.time() - t_start
@@ -312,15 +363,40 @@ def run_worker():
             dump_dir=os.environ.get('GLT_OBS_DUMP'))
       except Exception as e:  # keep the measured headline regardless
         stage_breakdown = {'error': str(e)[:200]}
+    for label, env in engine_envs.items():
+      if not isinstance(engines.get(label), dict):
+        continue
+      spent = time.time() - t_start
+      if worker_budget and worker_budget - spent < 90:
+        break
+      saved = {k: os.environ.get(k) for k in env}
+      os.environ.update(env)
+      try:
+        engines[label]['stage_breakdown'] = measure_stage_breakdown(
+            batches=4, batch_size=256)
+      except Exception as e:
+        engines[label]['stage_breakdown'] = {'error': str(e)[:200]}
+      finally:
+        for k, v in saved.items():
+          if v is None:
+            os.environ.pop(k, None)
+          else:
+            os.environ[k] = v
+
+  def engine_record(v):
+    if not isinstance(v, dict):
+      return v
+    rec = {'edges_per_sec': round(v['edges_per_sec'], 1),
+           'compile_s': round(v['compile_s'], 2),
+           'steady_recompiles': v['steady_recompiles']}
+    if 'stage_breakdown' in v:
+      rec['stage_breakdown'] = v['stage_breakdown']
+    return rec
 
   _emit(round(eps, 1), round(eps / A100_ASSUMED_EDGES_PER_SEC, 4),
         backend=dev.platform, scan=scan, iters=ITERS, batch=BATCH,
         engine=chosen,
-        engines={k: ({'edges_per_sec': round(v['edges_per_sec'], 1),
-                      'compile_s': round(v['compile_s'], 2),
-                      'steady_recompiles': v['steady_recompiles']}
-                     if isinstance(v, dict) else v)
-                 for k, v in engines.items()},
+        engines={k: engine_record(v) for k, v in engines.items()},
         train_steps_per_sec=train_ab,
         stage_breakdown=stage_breakdown)
 
@@ -366,7 +442,8 @@ def measure_stage_breakdown(batches: int = 8, num_nodes: int = 100_000,
       next(it)
     from glt_tpu.obs import get_registry
     snap = get_registry().snapshot()
-    out = {'warmup_compile_s': round(warm_s, 2), 'batches': batches}
+    out = {'warmup_compile_s': round(warm_s, 2), 'batches': batches,
+           'batch_size': batch_size}
     # spans NEST (loader.batch encloses sample.multihop and
     # gather.features), so raw per-stage totals double-count; report
     # self time (own duration minus direct children) so the stage

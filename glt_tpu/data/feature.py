@@ -237,6 +237,28 @@ class Feature:
     return _mixed_gather(self._hot, self.cold_array, rows,
                          row_gather=fn)
 
+  def fused_gather_fn(self, row_gather=None):
+    """Jit-safe ``ids [m] -> rows [m, D]`` closure for the in-walk
+    (``pallas_fused``) feature gather: identical op chain to
+    :func:`gather_features` on a fully-resident store — ``map_ids``
+    (clip semantics included) then :meth:`device_gather` through the
+    ``resolve_row_gather`` seam — so the assembled ``node_feats`` block
+    is bit-identical to the post-hoc gather, padded lanes included.
+    The returned closure captures this store's device buffers as
+    compile-time constants (the same trade the samplers make with the
+    graph arrays): swap the store, rebuild the sampler."""
+    self.lazy_init()
+    assert self.fully_device_resident, (
+        'the fused in-walk gather serves device-resident stores only; '
+        'spilled/offloaded rows keep the post-hoc gather_features path')
+    fn = row_gather if row_gather is not None else self.row_gather
+
+    def gather(ids):
+      rows = self.map_ids(ids.astype(jnp.int32))
+      return self.device_gather(rows, row_gather=fn)
+
+    return gather
+
   def cold_block_numpy(self) -> np.ndarray:
     """The whole cold block as numpy, whichever residency holds it
     (store builders reassemble [hot | cold] through this)."""
@@ -353,7 +375,7 @@ class Feature:
 
 
 def gather_features(feat: Optional[Feature], node,
-                    row_gather=None) -> Optional[jax.Array]:
+                    row_gather=None, fused=None) -> Optional[jax.Array]:
   """Batch gather over a Feature across BOTH residency classes — the
   single collate-time gather path shared by the training loaders
   (loader.node_loader) and the online serving engine (serving.engine).
@@ -361,16 +383,28 @@ def gather_features(feat: Optional[Feature], node,
   (gather_mixed) when offloaded, else the host phase. ``row_gather``
   overrides the device-resident gather kernel at the call site (see
   :meth:`Feature.device_gather`) — it survives feature swaps (e.g.
-  stream snapshot updates) because it rides the call, not the store."""
+  stream snapshot updates) because it rides the call, not the store.
+
+  ``fused``: a feature block the sampler already assembled IN-WALK (the
+  ``pallas_fused`` engine's ``node_feats`` metadata, bit-identical to
+  what this function would gather) — passed through as the result, so
+  every call site keeps one uniform entry point whichever engine ran.
+  The ``gather.features`` span still opens (recording ~0 self time):
+  per-stage breakdowns then show the gather cost moving INTO the fused
+  sample stage rather than silently vanishing."""
   if feat is None:
     return None
   from ..obs import get_tracer
   tracer = get_tracer()
   if tracer.enabled:
     _out = {}
-    with tracer.span('gather.features', sync=lambda: _out.get('x')):
-      _out['x'] = x = _gather_features(feat, node, row_gather)
+    with tracer.span('gather.features', sync=lambda: _out.get('x'),
+                     fused=fused is not None):
+      _out['x'] = x = (fused if fused is not None
+                       else _gather_features(feat, node, row_gather))
     return x
+  if fused is not None:
+    return fused
   return _gather_features(feat, node, row_gather)
 
 

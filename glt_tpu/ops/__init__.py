@@ -1,6 +1,6 @@
 from .sample import (
-    NeighborOutput, sample_neighbors, sample_neighbors_weighted,
-    neighbor_probs,
+    FusedHopPlan, NeighborOutput, sample_neighbors,
+    sample_neighbors_fused, sample_neighbors_weighted, neighbor_probs,
 )
 from .unique import ordered_unique, InducerState, init_node, induce_next
 from .negative import edge_in_csr, random_negative_sample, NegativeOutput
@@ -10,7 +10,8 @@ from .superstep import superstep, scan_consume
 from .delta import delta_one_hop, tombstone_mask
 
 __all__ = [
-    'NeighborOutput', 'sample_neighbors', 'sample_neighbors_weighted',
+    'FusedHopPlan', 'NeighborOutput', 'sample_neighbors',
+    'sample_neighbors_fused', 'sample_neighbors_weighted',
     'neighbor_probs',
     'ordered_unique', 'InducerState', 'init_node', 'induce_next',
     'edge_in_csr', 'random_negative_sample', 'NegativeOutput',

@@ -20,6 +20,19 @@ round-trips through HBM: each frontier row's window is DMA'd HBM->VMEM
 double-buffered across grid steps, the precomputed Floyd/replace
 offsets pick inside VMEM, and hub rows (degree > W) are fixed up by a
 per-element DMA tail pass folded into the same kernel.
+
+``sample_hop_dedup`` + ``dedup_table_insert``: the ``pallas_fused``
+kernel family. Extends ``sample_hop`` with the per-hop dedup stage run
+against a VMEM-resident open-addressing table (bucketized, 128 ids per
+bucket row so probes are vector compares), so the picked indices never
+leave VMEM between the sample and the assign: each grid step DMAs its
+CSR windows, picks in VMEM, and immediately probes/inserts the picks
+into the table, emitting provisional first-occurrence labels. The
+host-side wrapper (ops/sample.py::sample_neighbors_fused) converts
+those to the exact ``sorted_hop_dedup_fused`` label contract (new ids
+labeled in within-hop VALUE order) with ONE narrow single-operand sort
+over the fresh unique ids — strictly narrower than the 3-operand
+[C+M]-wide sort the ``sort+fused`` engine pays per hop.
 """
 from __future__ import annotations
 
@@ -186,6 +199,89 @@ def gather_rows(table: jax.Array, rows: jax.Array,
   return out.reshape(b, d)
 
 
+def _sampled_window_picks(n_blocks, block, width, fanout, starts_ref,
+                          hub_rows_ref, hub_slots_ref, offsets_ref,
+                          flag_ref, src_refs, win_bufs, hub_bufs, sems,
+                          hub_sems):
+  """The sampling stages shared — by construction, not by copy — by
+  ``sample_hop`` and ``sample_hop_dedup``: per-row window DMA
+  double-buffered across grid steps (slot (i+1)%2 issued while slot
+  i%2 computes), the in-VMEM one-hot offset pick, and the per-element
+  hub tail pass folded into the owning block's grid step. Returns the
+  merged picks ``[block, fanout]`` per source array; a divergence here
+  would break BOTH engines' bit-identity contracts at once instead of
+  silently forking them."""
+  from jax.experimental import pallas as pl
+  from jax.experimental.pallas import tpu as pltpu
+
+  n_a = len(src_refs)
+  n_hub = hub_rows_ref.shape[0]
+  i = pl.program_id(0)
+
+  def window_dma(a, slot, row, j):
+    st = starts_ref[row]
+    return pltpu.make_async_copy(src_refs[a].at[pl.ds(st, width)],
+                                 win_bufs[a].at[slot, j],
+                                 sems[a].at[slot, j])
+
+  def issue(slot, blk):
+    for j in range(block):
+      for a in range(n_a):
+        window_dma(a, slot, blk * block + j, j).start()
+
+  cur = jax.lax.rem(i, 2)
+  nxt = jax.lax.rem(i + 1, 2)
+
+  @pl.when(i == 0)
+  def _():
+    issue(cur, 0)                 # cold start: first block's windows
+
+  @pl.when(i + 1 < n_blocks)
+  def _():
+    issue(nxt, i + 1)             # double-buffer: next block in flight
+
+  for j in range(block):
+    for a in range(n_a):
+      window_dma(a, cur, i * block + j, j).wait()
+
+  # hub tail pass: exact per-element reads for rows whose degree
+  # exceeds the window, folded into the owning block's grid step
+  def hub_issue(h, _):
+    row = hub_rows_ref[h]
+    in_block = (row >= i * block) & (row < (i + 1) * block)
+
+    @pl.when(in_block)
+    def _():
+      j = row - i * block
+      for k in range(fanout):
+        sl = hub_slots_ref[h, k]
+        for a in range(n_a):
+          pltpu.make_async_copy(src_refs[a].at[pl.ds(sl, 1)],
+                                hub_bufs[a].at[j, pl.ds(k, 1)],
+                                hub_sems[a].at[j, k]).start()
+      for k in range(fanout):
+        sl = hub_slots_ref[h, k]
+        for a in range(n_a):
+          pltpu.make_async_copy(src_refs[a].at[pl.ds(sl, 1)],
+                                hub_bufs[a].at[j, pl.ds(k, 1)],
+                                hub_sems[a].at[j, k]).wait()
+    return 0
+
+  jax.lax.fori_loop(0, n_hub, hub_issue, 0)
+
+  woff = jnp.minimum(offsets_ref[...], width - 1)      # [block, K]
+  iota = jax.lax.broadcasted_iota(jnp.int32, (block, fanout, width), 2)
+  onehot = iota == woff[:, :, None]
+  is_hub = flag_ref[...] != 0                          # [block, 1]
+  merged = []
+  for a in range(n_a):
+    win = win_bufs[a][cur]                             # [block, W]
+    zero = jnp.zeros((), win.dtype)
+    picks = jnp.sum(jnp.where(onehot, win[:, None, :], zero), axis=-1)
+    merged.append(jnp.where(is_hub, hub_bufs[a][...], picks))
+  return merged
+
+
 @functools.partial(jax.jit, static_argnames=('width', 'block',
                                              'interpret'))
 def sample_hop(arr_win: jax.Array,
@@ -272,68 +368,12 @@ def sample_hop(arr_win: jax.Array,
     hub_bufs = rest[3 * len(arrs):4 * len(arrs)]
     sems = rest[4 * len(arrs):5 * len(arrs)]
     hub_sems = rest[5 * len(arrs):6 * len(arrs)]
-    i = pl.program_id(0)
-
-    def window_dma(a, slot, row, j):
-      st = starts_ref[row]
-      return pltpu.make_async_copy(src_refs[a].at[pl.ds(st, width)],
-                                   win_bufs[a].at[slot, j],
-                                   sems[a].at[slot, j])
-
-    def issue(slot, blk):
-      for j in range(block):
-        for a in range(len(arrs)):
-          window_dma(a, slot, blk * block + j, j).start()
-
-    cur = jax.lax.rem(i, 2)
-    nxt = jax.lax.rem(i + 1, 2)
-
-    @pl.when(i == 0)
-    def _():
-      issue(cur, 0)                 # cold start: first block's windows
-
-    @pl.when(i + 1 < n_blocks)
-    def _():
-      issue(nxt, i + 1)             # double-buffer: next block in flight
-
-    for j in range(block):
-      for a in range(len(arrs)):
-        window_dma(a, cur, i * block + j, j).wait()
-
-    # hub tail pass: exact per-element reads for rows whose degree
-    # exceeds the window, folded into the owning block's grid step
-    def hub_issue(h, _):
-      row = hub_rows_ref[h]
-      in_block = (row >= i * block) & (row < (i + 1) * block)
-
-      @pl.when(in_block)
-      def _():
-        j = row - i * block
-        for k in range(fanout):
-          sl = hub_slots_ref[h, k]
-          for a in range(len(arrs)):
-            pltpu.make_async_copy(src_refs[a].at[pl.ds(sl, 1)],
-                                  hub_bufs[a].at[j, pl.ds(k, 1)],
-                                  hub_sems[a].at[j, k]).start()
-        for k in range(fanout):
-          sl = hub_slots_ref[h, k]
-          for a in range(len(arrs)):
-            pltpu.make_async_copy(src_refs[a].at[pl.ds(sl, 1)],
-                                  hub_bufs[a].at[j, pl.ds(k, 1)],
-                                  hub_sems[a].at[j, k]).wait()
-      return 0
-
-    jax.lax.fori_loop(0, n_hub, hub_issue, 0)
-
-    woff = jnp.minimum(offsets_ref[...], width - 1)      # [block, K]
-    iota = jax.lax.broadcasted_iota(jnp.int32, (block, fanout, width), 2)
-    onehot = iota == woff[:, :, None]
-    is_hub = flag_ref[...] != 0                          # [block, 1]
+    merged = _sampled_window_picks(
+        n_blocks, block, width, fanout, starts_ref, hub_rows_ref,
+        hub_slots_ref, offsets_ref, flag_ref, src_refs, win_bufs,
+        hub_bufs, sems, hub_sems)
     for a in range(len(arrs)):
-      win = win_bufs[a][cur]                             # [block, W]
-      zero = jnp.zeros((), win.dtype)
-      picks = jnp.sum(jnp.where(onehot, win[:, None, :], zero), axis=-1)
-      out_refs[a][...] = jnp.where(is_hub, hub_bufs[a][...], picks)
+      out_refs[a][...] = merged[a]
 
   grid_spec = pltpu.PrefetchScalarGridSpec(
       num_scalar_prefetch=3,
@@ -359,3 +399,355 @@ def sample_hop(arr_win: jax.Array,
   )(starts, hub_rows, hub_slots, offsets, hub_flag, *arrs)
   picks = outs[0][:s]
   return picks, (outs[1][:s] if with_eids else None)
+
+
+# ---------------------------------------------------------------------------
+# pallas_fused: sample -> dedup fused in one kernel (ISSUE 10 tentpole).
+#
+# The dedup table is a bucketized open-addressing hash table living in
+# VMEM for the whole kernel: [n_buckets, 128] int32 ids + labels, so a
+# probe is ONE vector load + compare over a bucket's 128 lanes instead
+# of 128 scalar reads. Grid steps run sequentially on TPU, which makes
+# the insert order deterministic (slot order) — the same first-
+# occurrence semantics the sort engines recover with stable sorts.
+# ---------------------------------------------------------------------------
+
+#: lanes per hash bucket — one VMEM vector row per probe
+TABLE_LANES = 128
+
+
+def fused_table_max_slots() -> int:
+  """VMEM dedup-table sizing knob: the largest table (in id slots) the
+  ``pallas_fused`` engine may allocate. Both planes (ids + labels) of a
+  full-size table cost ``2 * slots * 4`` bytes of VMEM for the whole
+  kernel — the default (2^20 slots = 8 MB) leaves room for the window
+  double-buffers inside a 16 MB VMEM budget. A multihop whose node
+  budget needs more slots falls back to the ``pallas`` engine (counted
+  in ``hop_engine_fallbacks_total``)."""
+  return int(os.environ.get('GLT_FUSED_TABLE_SLOTS', str(1 << 20)))
+
+
+def fused_table_slots(budget: int) -> int:
+  """Slots for a walk with ``budget`` worst-case distinct nodes: the
+  next power-of-two bucket count whose slot count covers the budget
+  (capacity > occupancy guarantees probe termination; typical fill is
+  the ACTUAL distinct count, far below the static budget, so the load
+  factor in practice stays low)."""
+  n_buckets = 8  # (8, 128) min int32 tile
+  while n_buckets * TABLE_LANES <= budget:
+    n_buckets *= 2
+  return n_buckets * TABLE_LANES
+
+
+def make_dedup_table(slots: int):
+  """Fresh (ids, labels) table planes; -1 marks an empty lane."""
+  assert slots % TABLE_LANES == 0
+  shape = (slots // TABLE_LANES, TABLE_LANES)
+  return (jnp.full(shape, -1, jnp.int32), jnp.full(shape, -1, jnp.int32))
+
+
+def _hash_bucket(x, n_buckets):
+  """Multiplicative (Fibonacci) hash of an int32 id -> bucket index."""
+  h = x * jnp.int32(-1640531527)
+  h = jnp.bitwise_xor(h, jax.lax.shift_right_logical(h, 16))
+  return jnp.bitwise_and(h, n_buckets - 1)
+
+
+def _probe(tab_ids_ref, x, n_buckets):
+  """Walk buckets from hash(x) until one holds ``x`` or has an empty
+  lane. Terminates because callers size the table past the worst-case
+  occupancy (fused_table_slots) and lanes are never deleted; the cond
+  is pure (loads live in the body) so the loop discharges in interpret
+  mode."""
+  from jax.experimental import pallas as pl
+
+  def cond(c):
+    return jnp.logical_not(c[1])
+
+  def step(c):
+    b, _ = c
+    row = tab_ids_ref[pl.ds(b, 1), :]
+    stop = jnp.any(row == x) | jnp.any(row == -1)
+    return (jnp.where(stop, b, jnp.bitwise_and(b + 1, n_buckets - 1)),
+            stop)
+
+  b, _ = jax.lax.while_loop(cond, step, (_hash_bucket(x, n_buckets),
+                                         False))
+  return b
+
+
+def _probe_insert(tab_ids_ref, tab_labs_ref, x, valid, new_label,
+                  n_buckets, lane_iota):
+  """One dedup element: find ``x``'s bucket, return (label, inserted).
+  Invalid elements probe with -1 (stops at the first empty lane, never
+  matches a real id as "found new") and are neutralized by masked
+  writes, so the whole element is straight-line code — no pl.when."""
+  from jax.experimental import pallas as pl
+  xs = jnp.where(valid, x, jnp.int32(-1))
+  b = _probe(tab_ids_ref, xs, n_buckets)
+  row = tab_ids_ref[pl.ds(b, 1), :]
+  eq = row == xs
+  # xs == -1 "finds" the empty lanes; valid gating below discards it
+  found = jnp.any(eq)
+  do_insert = jnp.logical_and(valid, jnp.logical_not(found))
+  labrow = tab_labs_ref[pl.ds(b, 1), :]
+  found_lab = jnp.max(jnp.where(eq, labrow, -1))
+  empty = row == -1
+  first_empty = jnp.min(jnp.where(empty, lane_iota, TABLE_LANES))
+  put = jnp.logical_and(do_insert, lane_iota == first_empty)
+  tab_ids_ref[pl.ds(b, 1), :] = jnp.where(put, xs, row)
+  tab_labs_ref[pl.ds(b, 1), :] = jnp.where(put, new_label, labrow)
+  lab = jnp.where(valid,
+                  jnp.where(found, found_lab, new_label),
+                  jnp.int32(-1))
+  return lab, do_insert.astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=('interpret',))
+def dedup_table_insert(tab_ids: jax.Array, tab_labs: jax.Array,
+                       ids: jax.Array, labs: jax.Array,
+                       valid: jax.Array,
+                       interpret: bool = False):
+  """Insert pre-labeled ids into the dedup table (the seed hop: labels
+  come from the EXACT seed dedup, the table just has to agree with them
+  before the first fused hop probes it). Already-present ids keep their
+  stored label; invalid slots are no-ops. Returns the updated planes.
+  """
+  from jax.experimental import pallas as pl
+  from jax.experimental.pallas import tpu as pltpu
+
+  n_buckets = tab_ids.shape[0]
+  m = ids.shape[0]
+  if m == 0:
+    return tab_ids, tab_labs
+  ids = ids.astype(jnp.int32)
+  labs = labs.astype(jnp.int32)
+  valid = valid.astype(jnp.int32)
+
+  def kernel(ids_ref, labs_ref, valid_ref, ids_in, labs_in,
+             ids_out, labs_out, tids, tlabs, sems):
+    # table planes live in HBM (ANY) in/out; ONE VMEM copy is staged
+    # by explicit DMA — blocked in+out specs would keep TWO resident
+    # copies per plane and double the VMEM footprint
+    pltpu.make_async_copy(ids_in, tids, sems.at[0]).start()
+    pltpu.make_async_copy(labs_in, tlabs, sems.at[1]).start()
+    pltpu.make_async_copy(ids_in, tids, sems.at[0]).wait()
+    pltpu.make_async_copy(labs_in, tlabs, sems.at[1]).wait()
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, TABLE_LANES), 1)
+
+    def body(t, _):
+      _probe_insert(tids, tlabs, ids_ref[t], valid_ref[t] != 0,
+                    labs_ref[t], n_buckets, lane)
+      return 0
+
+    jax.lax.fori_loop(0, m, body, 0)
+    pltpu.make_async_copy(tids, ids_out, sems.at[0]).start()
+    pltpu.make_async_copy(tlabs, labs_out, sems.at[1]).start()
+    pltpu.make_async_copy(tids, ids_out, sems.at[0]).wait()
+    pltpu.make_async_copy(tlabs, labs_out, sems.at[1]).wait()
+
+  grid_spec = pltpu.PrefetchScalarGridSpec(
+      num_scalar_prefetch=3,
+      grid=(1,),
+      in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY)],
+      out_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                 pl.BlockSpec(memory_space=pl.ANY)],
+      scratch_shapes=[pltpu.VMEM(tab_ids.shape, jnp.int32),
+                      pltpu.VMEM(tab_ids.shape, jnp.int32),
+                      pltpu.SemaphoreType.DMA((2,))],
+  )
+  return pl.pallas_call(
+      kernel,
+      grid_spec=grid_spec,
+      out_shape=[jax.ShapeDtypeStruct(tab_ids.shape, jnp.int32),
+                 jax.ShapeDtypeStruct(tab_ids.shape, jnp.int32)],
+      interpret=interpret,
+  )(ids, labs, valid, tab_ids, tab_labs)
+
+
+@functools.partial(jax.jit, static_argnames=('width', 'block',
+                                             'interpret'))
+def sample_hop_dedup(arr_win: jax.Array,
+                     eids_win: 'Optional[jax.Array]',
+                     starts: jax.Array,
+                     offsets: jax.Array,
+                     valid: jax.Array,
+                     hub_rows: jax.Array,
+                     hub_slots: jax.Array,
+                     tab_ids: jax.Array,
+                     tab_labs: jax.Array,
+                     count: jax.Array,
+                     width: int,
+                     block: int = 8,
+                     interpret: bool = False):
+  """The fused hop megakernel: window DMA + offset pick + hub tail +
+  dedup-table assign, all in one kernel.
+
+  The sampling stages are ``sample_hop``'s, unchanged (same
+  double-buffered window DMA slots, same one-hot pick, same per-element
+  hub fix-up). The new stage runs right after the pick, on the merged
+  picks still in VMEM: each element probes the resident dedup table
+  (``_probe_insert``) in slot order — grid steps are sequential, so
+  insertion order is deterministic — and emits a PROVISIONAL label:
+  previously seen ids return their stored label, fresh ids get
+  ``count + r`` in first-occurrence order (r = running insert counter,
+  carried across grid steps in SMEM). The ``sorted_hop_dedup_fused``
+  value-order label contract is restored by the caller with one narrow
+  sort over the fresh ids (ops/sample.py::sample_neighbors_fused),
+  which also rewrites the table's labels for the next hop.
+
+  Args (beyond sample_hop's):
+    valid: [S, K] int32/bool element validity (the sample mask) — the
+      dedup stage skips invalid lanes.
+    tab_ids / tab_labs: [n_buckets, 128] table planes (make_dedup_table
+      or a previous hop's outputs); n_buckets must be a power of two.
+    count: scalar int32, labels assigned before this hop.
+
+  Returns (picks, eid_picks|None, prov_labels [S, K], new_head [S, K]
+  int32, tab_ids', tab_labs').
+  """
+  from jax.experimental import pallas as pl
+  from jax.experimental.pallas import tpu as pltpu
+
+  s = starts.shape[0]
+  fanout = offsets.shape[1]
+  n_hub = hub_rows.shape[0]
+  n_buckets = tab_ids.shape[0]
+  assert n_buckets & (n_buckets - 1) == 0, 'bucket count must be pow2'
+  with_eids = eids_win is not None
+  if s == 0:
+    empty = jnp.zeros((0, fanout), arr_win.dtype)
+    return (empty,
+            jnp.zeros((0, fanout), eids_win.dtype) if with_eids else None,
+            jnp.zeros((0, fanout), jnp.int32),
+            jnp.zeros((0, fanout), jnp.int32), tab_ids, tab_labs)
+  starts = starts.astype(jnp.int32)
+  offsets = offsets.astype(jnp.int32)
+  valid = valid.astype(jnp.int32)
+  pad = (-s) % block
+  if pad:
+    starts = jnp.pad(starts, (0, pad))
+    offsets = jnp.pad(offsets, ((0, pad), (0, 0)))
+    valid = jnp.pad(valid, ((0, pad), (0, 0)))  # padded rows never insert
+  n_blocks = (s + pad) // block
+  valid_hub = (hub_rows >= 0).astype(jnp.int32)
+  hub_flag = jnp.zeros((s + pad, 1), jnp.int32).at[
+      jnp.clip(hub_rows, 0, s + pad - 1), 0].max(valid_hub)
+  hub_rows = jnp.where(valid_hub > 0, hub_rows, -1).astype(jnp.int32)
+  hub_slots = hub_slots.astype(jnp.int32)
+  count = count.astype(jnp.int32).reshape((1,))
+
+  arrs = (arr_win, eids_win) if with_eids else (arr_win,)
+  n_a = len(arrs)
+
+  def kernel(starts_ref, hub_rows_ref, hub_slots_ref, count_ref,
+             offsets_ref, flag_ref, valid_ref, tids_in, tlabs_in,
+             *rest):
+    src_refs = rest[:n_a]
+    out_refs = rest[n_a:2 * n_a]
+    lab_ref, newh_ref, tids_out, tlabs_out = rest[2 * n_a:2 * n_a + 4]
+    scr = rest[2 * n_a + 4:]
+    win_bufs = scr[:n_a]
+    hub_bufs = scr[n_a:2 * n_a]
+    sems = scr[2 * n_a:3 * n_a]
+    hub_sems = scr[3 * n_a:4 * n_a]
+    r_ref, tids, tlabs, tsems = scr[4 * n_a:4 * n_a + 4]
+    i = pl.program_id(0)
+
+    # table planes ride HBM (ANY) in/out; the working copy is ONE VMEM
+    # scratch per plane, DMA'd in at the first step and written back at
+    # the last — blocked in+out table specs would pin two resident
+    # copies per plane (2x the table's VMEM share for nothing)
+    @pl.when(i == 0)
+    def _():
+      pltpu.make_async_copy(tids_in, tids, tsems.at[0]).start()
+      pltpu.make_async_copy(tlabs_in, tlabs, tsems.at[1]).start()
+      pltpu.make_async_copy(tids_in, tids, tsems.at[0]).wait()
+      pltpu.make_async_copy(tlabs_in, tlabs, tsems.at[1]).wait()
+      r_ref[0] = 0
+
+    # sampling stages: the SAME helper sample_hop runs — the fused
+    # kernel only appends the dedup stage below
+    merged = _sampled_window_picks(
+        n_blocks, block, width, fanout, starts_ref, hub_rows_ref,
+        hub_slots_ref, offsets_ref, flag_ref, src_refs, win_bufs,
+        hub_bufs, sems, hub_sems)
+    for a in range(n_a):
+      out_refs[a][...] = merged[a]
+    picks0 = merged[0]
+
+    # dedup stage: probe/insert the merged picks, slot order (row-major
+    # over [block, fanout], sequential grid => global slot order)
+    base = count_ref[0]
+    r = r_ref[0]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, TABLE_LANES), 1)
+    lab_rows, newh_rows = [], []
+    for j in range(block):
+      labs_k, newh_k = [], []
+      for k in range(fanout):
+        x = picks0[j, k].astype(jnp.int32)
+        v = valid_ref[j, k] != 0
+        lab, is_new = _probe_insert(tids, tlabs, x, v,
+                                    base + r, n_buckets, lane)
+        labs_k.append(lab)
+        newh_k.append(is_new)
+        r = r + is_new
+      lab_rows.append(jnp.stack(labs_k))
+      newh_rows.append(jnp.stack(newh_k))
+    lab_ref[...] = jnp.stack(lab_rows)
+    newh_ref[...] = jnp.stack(newh_rows)
+    r_ref[0] = r
+
+    @pl.when(i == n_blocks - 1)
+    def _():
+      pltpu.make_async_copy(tids, tids_out, tsems.at[0]).start()
+      pltpu.make_async_copy(tlabs, tlabs_out, tsems.at[1]).start()
+      pltpu.make_async_copy(tids, tids_out, tsems.at[0]).wait()
+      pltpu.make_async_copy(tlabs, tlabs_out, tsems.at[1]).wait()
+
+  tshape = tab_ids.shape
+  grid_spec = pltpu.PrefetchScalarGridSpec(
+      num_scalar_prefetch=4,
+      grid=(n_blocks,),
+      in_specs=(
+          [pl.BlockSpec((block, fanout), lambda i, *_: (i, 0)),
+           pl.BlockSpec((block, 1), lambda i, *_: (i, 0)),
+           pl.BlockSpec((block, fanout), lambda i, *_: (i, 0)),
+           pl.BlockSpec(memory_space=pl.ANY),
+           pl.BlockSpec(memory_space=pl.ANY)]
+          + [pl.BlockSpec(memory_space=pl.ANY)] * n_a),
+      out_specs=([pl.BlockSpec((block, fanout), lambda i, *_: (i, 0))
+                  for _ in arrs]
+                 + [pl.BlockSpec((block, fanout), lambda i, *_: (i, 0)),
+                    pl.BlockSpec((block, fanout), lambda i, *_: (i, 0)),
+                    pl.BlockSpec(memory_space=pl.ANY),
+                    pl.BlockSpec(memory_space=pl.ANY)]),
+      scratch_shapes=(
+          [pltpu.VMEM((2, block, width), a.dtype) for a in arrs]
+          + [pltpu.VMEM((block, fanout), a.dtype) for a in arrs]
+          + [pltpu.SemaphoreType.DMA((2, block)) for _ in arrs]
+          + [pltpu.SemaphoreType.DMA((block, fanout)) for _ in arrs]
+          + [pltpu.SMEM((1,), jnp.int32),
+             pltpu.VMEM(tshape, jnp.int32),
+             pltpu.VMEM(tshape, jnp.int32),
+             pltpu.SemaphoreType.DMA((2,))]),
+  )
+  outs = pl.pallas_call(
+      kernel,
+      grid_spec=grid_spec,
+      out_shape=([jax.ShapeDtypeStruct((s + pad, fanout), a.dtype)
+                  for a in arrs]
+                 + [jax.ShapeDtypeStruct((s + pad, fanout), jnp.int32),
+                    jax.ShapeDtypeStruct((s + pad, fanout), jnp.int32),
+                    jax.ShapeDtypeStruct(tshape, jnp.int32),
+                    jax.ShapeDtypeStruct(tshape, jnp.int32)]),
+      interpret=interpret,
+  )(starts, hub_rows, hub_slots, count, offsets, hub_flag, valid,
+    tab_ids, tab_labs, *arrs)
+  picks = outs[0][:s]
+  eid_picks = outs[1][:s] if with_eids else None
+  prov_labels = outs[n_a][:s]
+  new_head = outs[n_a + 1][:s]
+  return (picks, eid_picks, prov_labels, new_head,
+          outs[n_a + 2], outs[n_a + 3])

@@ -34,6 +34,12 @@ def dedup_engine() -> str:
   if mode not in ('auto', 'sort', 'table'):
     raise ValueError(f'GLT_DEDUP={mode!r}: expected auto|sort|table')
   if mode == 'auto':
+    if os.environ.get('GLT_HOP_ENGINE') == 'pallas_fused':
+      # the fused engine implements the sort/fused inducer CONTRACT in
+      # its kernel (and its fallbacks land on the sort path), so the
+      # auto dedup choice follows it on every backend — flipping to
+      # dense tables mid-stack would allocate O(N) HBM nothing reads
+      return 'sort'
     return 'sort' if jax.default_backend() == 'tpu' else 'table'
   return mode
 
@@ -62,7 +68,38 @@ def fused_hops() -> bool:
 
 #: registered one-hop neighbor-read engines (sampler-side dispatch —
 #: distinct from the dedup engines above, which pick the inducer)
-HOP_ENGINES = ('element', 'window', 'pallas')
+HOP_ENGINES = ('element', 'window', 'pallas', 'pallas_fused')
+
+
+#: env-level fallback events already counted this process — hop_engine()
+#: is read per hop per trace, and a per-read count would report one
+#: configuration event hops x traces times (sampler-level reasons
+#: dedupe per sampler instance via their own sets)
+_COUNTED_ENV_FALLBACKS = set()
+
+
+def count_engine_fallback(requested: str, resolved: str,
+                          reason: str) -> None:
+  """Record an engine-fallback event on the metrics registry
+  (``hop_engine_fallbacks_total{requested,resolved,reason}``): a
+  requested ``pallas``/``pallas_fused`` engine silently resolving to a
+  weaker one is an operational fact worth a counter, not just a log
+  line — dashboards can alert on a fleet that quietly lost its fused
+  kernels. Counted once per resolution event — a sampler gating a
+  shape it can't fuse (callers dedupe per instance) or a process whose
+  env requests an unimportable engine — never per sample call or per
+  trace-time env read."""
+  import logging
+  logging.getLogger(__name__).warning(
+      'GLT_HOP_ENGINE=%s resolved to %r (%s)', requested, resolved,
+      reason)
+  try:
+    from ..obs import get_registry
+    get_registry().counter('hop_engine_fallbacks_total',
+                           requested=requested, resolved=resolved,
+                           reason=reason).inc()
+  except Exception:  # metrics must never break sampling
+    pass
 
 
 def hop_engine() -> str:
@@ -76,26 +113,36 @@ def hop_engine() -> str:
     hub tail pass fused in one Pallas kernel
     (ops/pallas_kernels.py::sample_hop). Off-TPU backends run it in
     interpret mode (parity/CI); only a TPU backend runs it compiled.
+  * ``pallas_fused`` — the full per-hop pipeline fused: sample + dedup
+    against a VMEM-resident table in one kernel, plus the optional
+    in-walk feature row gather (ops/pallas_kernels.py::
+    sample_hop_dedup, routed via ops/sample.py::FusedHopPlan). Label
+    semantics are exactly the ``sort+fused`` inducer's; hops the
+    fusion cannot serve (hetero, weighted, full-neighborhood, stream
+    overlays, table-overflow budgets) fall back to ``pallas`` with a
+    counted ``hop_engine_fallbacks_total`` event.
 
   ``GLT_HOP_ENGINE`` selects; ``auto`` (the default) is ``element``
   until the hardware A/B (bench.py races the engines and records the
   winner in its ``engines{}``) justifies flipping the default. All
-  three engines draw offsets from the same ``jax.random`` stream, so
-  results are bit-identical (ops/sample.py). Read at trace time, like
-  :func:`dedup_engine`."""
+  engines draw offsets from the same ``jax.random`` stream, so results
+  are bit-identical (ops/sample.py; ``pallas_fused`` is bit-identical
+  to the ``sort+fused`` dedup engine, which it subsumes). Read at
+  trace time, like :func:`dedup_engine`."""
   mode = os.environ.get('GLT_HOP_ENGINE', 'auto')
   if mode not in ('auto',) + HOP_ENGINES:
     raise ValueError(
-        f'GLT_HOP_ENGINE={mode!r}: expected auto|element|window|pallas')
+        f'GLT_HOP_ENGINE={mode!r}: expected '
+        'auto|element|window|pallas|pallas_fused')
   if mode == 'auto':
     return 'element'
-  if mode == 'pallas':
+  if mode in ('pallas', 'pallas_fused'):
     from .pallas_kernels import pallas_available
     if not pallas_available():
-      import logging
-      logging.getLogger(__name__).warning(
-          'GLT_HOP_ENGINE=pallas but jax.experimental.pallas is '
-          'unavailable; falling back to the window engine')
+      key = (mode, 'window', 'pallas_unimportable')
+      if key not in _COUNTED_ENV_FALLBACKS:  # one config event, not
+        _COUNTED_ENV_FALLBACKS.add(key)      # one per env read
+        count_engine_fallback(*key)
       return 'window'
   return mode
 
@@ -172,11 +219,23 @@ def multihop_sample(one_hop: OneHopFn,
                     key: jax.Array,
                     table: jax.Array,
                     scratch: jax.Array,
-                    with_edge: bool = False) -> Dict[str, jax.Array]:
+                    with_edge: bool = False,
+                    fused_plan=None) -> Dict[str, jax.Array]:
   """Runs the full hop loop; returns (out_dict, table, scratch).
 
   ``one_hop(frontier_ids, fanout, key, mask)`` performs one sampling hop.
   Tables are returned reset, ready for the next batch.
+
+  ``fused_plan`` (an :class:`glt_tpu.ops.sample.FusedHopPlan`) routes
+  every hop through the ``pallas_fused`` kernel family instead of
+  ``one_hop`` + the sort dedup — label semantics identical to the
+  ``sort+fused`` engine (the seed hop stays on the exact path), with
+  the dedup table resident in VMEM and, when the plan carries a
+  ``gather_fn``, each hop's fresh feature rows gathered in-walk
+  (``node_feats`` lands in the output dict). The dedup-engine knob is
+  ignored on this path; ``table``/``scratch`` pass through untouched
+  (allocate them with :func:`make_dedup_tables`, which hands out
+  placeholders under this engine).
 
   Result contract (both engines, homo and hetero): lanes where
   ``edge_mask`` is False carry -1 in the child-label buffer (``row``
@@ -185,6 +244,10 @@ def multihop_sample(one_hop: OneHopFn,
   edge_mask still see one well-defined value per engine
   (tests/test_sorted_inducer.py pins this).
   """
+  if fused_plan is not None:
+    out = _multihop_sample_fused(fused_plan, seeds, n_valid, fanouts,
+                                 key, with_edge=with_edge)
+    return out, table, scratch
   if dedup_engine() == 'sort':
     out = _multihop_sample_sorted(one_hop, seeds, n_valid, fanouts, key,
                                   with_edge=with_edge)
@@ -338,6 +401,126 @@ def _multihop_sample_sorted(one_hop: OneHopFn,
   if with_edge:
     out_dict['edge'] = jnp.concatenate(eid_list)
   return out_dict
+
+
+def _multihop_sample_fused(plan, seeds, n_valid, fanouts, key,
+                           with_edge: bool = False):
+  """The hop loop on the ``pallas_fused`` kernel family: the seed hop
+  dedups on the EXACT sorted path (same as the fused sort engine, so
+  ``batch``/``seed_labels`` stay bit-identical to every engine), its
+  uniques seed the VMEM dedup table, and each subsequent hop is ONE
+  fused kernel call (sample + table assign) plus the narrow value-order
+  relabel — outputs bit-identical to ``sort+fused``
+  (GLT_DEDUP=sort GLT_FUSED_HOP=1), asserted in interpret mode by
+  tests/test_pallas_fused.py. With ``plan.gather_fn``, each hop's fresh
+  unique rows are feature-gathered while the walk runs and assembled
+  into ``node_feats`` (label order = row order, exactly
+  ``gather_features(feat, node)`` including the padded-lane values)."""
+  big = jnp.iinfo(jnp.int32).max
+  batch_size = seeds.shape[0]
+  budget = sample_budget(batch_size, fanouts)
+  seed_mask = jnp.arange(batch_size) < n_valid
+
+  u_ids = jnp.zeros((0,), jnp.int32)
+  u_labs = jnp.zeros((0,), jnp.int32)
+  count = jnp.zeros((), jnp.int32)
+  d = sorted_hop_dedup(u_ids, u_labs, count, seeds, seed_mask)
+  seed_labels = jax.lax.sort([d['pos3'], d['labels3']], num_keys=1)[1]
+  seed_labels = jnp.where(seed_mask, seed_labels, -1)
+  seed_count = d['count2']
+  u_ids, u_labs, count = d['u_ids2'], d['u_labs2'], d['count2']
+  frontier_ids = d['ids3']
+  frontier_labels = d['labels3']
+  frontier_mask = d['new_head3']
+  table = plan.init_table(jnp.where(d['new_head3'], d['ids3'], -1),
+                          d['labels3'],
+                          d['new_head3'].astype(jnp.int32))
+
+  feats = None
+  if plan.gather_fn is not None:
+    feats = jnp.zeros((budget + 1, plan.feat_dim), plan.feat_dtype)
+    # seed rows in label order: one tiny [B] sort
+    lab_key = jnp.where(d['new_head3'], d['labels3'], big)
+    seed_sorted = jax.lax.sort(
+        [lab_key, jnp.where(d['new_head3'], d['ids3'], big)],
+        num_keys=1)[1]
+    feats = _gather_fresh_rows(feats, plan.gather_fn, seed_sorted,
+                               jnp.zeros((), jnp.int32), seed_count,
+                               budget)
+
+  rows_parent, cols_child, emasks, eid_list = [], [], [], []
+  hop_node_counts = [seed_count]
+  hop_edge_counts = []
+  for hop_idx, fanout in enumerate(fanouts):
+    width = abs(fanout)
+    key, sub = jax.random.split(key)
+    # one fused kernel = the whole sample+dedup stage; a single device
+    # profiler scope covers what sample_hop<i>+dedup<i> label elsewhere
+    with jax.named_scope(f'sample_dedup_fused{hop_idx}'):
+      out, dd, table = plan(frontier_ids, fanout, sub, frontier_mask,
+                            table, count)
+    ids_flat = out.nbrs.reshape(-1).astype(jnp.int32)
+    mask_flat = out.mask.reshape(-1)
+    rows_parent.append(jnp.repeat(frontier_labels, width))
+    cols_child.append(dd['labels3'])
+    emasks.append(mask_flat)
+    if with_edge:
+      eid_list.append(out.eids.reshape(-1))
+    u_ids = jnp.concatenate(
+        [u_ids, jnp.where(dd['new_head3'], ids_flat, big)])
+    u_labs = jnp.concatenate(
+        [u_labs, jnp.where(dd['new_head3'], dd['labels3'], big)])
+    if feats is not None:
+      with jax.named_scope(f'gather_fused{hop_idx}'):
+        feats = _gather_fresh_rows(feats, plan.gather_fn,
+                                   dd['sorted_new_ids'], count,
+                                   dd['new_count'], budget)
+    frontier_ids = jnp.where(dd['new_head3'], ids_flat, big)
+    frontier_labels = dd['labels3']
+    frontier_mask = dd['new_head3']
+    hop_node_counts.append(dd['new_count'])
+    hop_edge_counts.append(out.mask.sum().astype(jnp.int32))
+    count = dd['count2']
+
+  nodes = sorted_nodes_by_label(u_ids, u_labs, count, budget)
+  out_dict = dict(
+      node=nodes,
+      node_count=count,
+      row=jnp.concatenate(cols_child),
+      col=jnp.concatenate(rows_parent),
+      edge_mask=jnp.concatenate(emasks),
+      batch=jax.lax.slice(nodes, (0,), (batch_size,)),
+      seed_labels=seed_labels,
+      seed_count=seed_count,
+      num_sampled_nodes=jnp.stack(hop_node_counts),
+      num_sampled_edges=jnp.stack(hop_edge_counts),
+  )
+  if with_edge:
+    out_dict['edge'] = jnp.concatenate(eid_list)
+  if feats is not None:
+    # padded lanes (label >= count) must match the post-hoc gather at
+    # node == -1 bit-for-bit, so parity with gather_features holds on
+    # EVERY lane, not just the live prefix
+    pad_row = plan.gather_fn(jnp.full((1,), -1, jnp.int32))
+    lanes = jnp.arange(budget) < count
+    out_dict['node_feats'] = jnp.where(lanes[:, None], feats[:budget],
+                                       pad_row)
+  return out_dict
+
+
+def _gather_fresh_rows(feats, gather_fn, ids_sorted, base, n_new,
+                       budget):
+  """Gather one stage's fresh unique rows (ascending id = label order)
+  and scatter them at labels ``base..base+n_new-1``; lanes past
+  ``n_new`` land on the sink row. The gather itself rides the plan's
+  ``gather_fn`` — the resolve_row_gather seam, so injected/Pallas row
+  kernels serve the fused path exactly like the post-hoc one."""
+  cap = ids_sorted.shape[0]
+  vals = gather_fn(ids_sorted)
+  iota = jnp.arange(cap, dtype=jnp.int32)
+  idx = jnp.where(iota < n_new, base + iota, budget)
+  idx = jnp.clip(idx, 0, budget)
+  return feats.at[idx].set(vals.astype(feats.dtype))
 
 
 def hetero_edge_capacities(caps, trav, num_neighbors, num_hops):
@@ -591,7 +774,8 @@ def multihop_sample_many(one_hop: OneHopFn,
                          key: jax.Array,
                          table: jax.Array,
                          scratch: jax.Array,
-                         with_edge: bool = False):
+                         with_edge: bool = False,
+                         fused_plan=None):
   """T sampling batches in ONE dispatch via lax.scan.
 
   seeds_stack: [T, B]; n_valid_stack: [T]. Returns (stacked out dicts
@@ -599,13 +783,16 @@ def multihop_sample_many(one_hop: OneHopFn,
   round-trips dominate (e.g. small batches over an interconnect-attached
   accelerator); the per-batch table reset keeps iterations independent,
   so results are identical to T separate multihop_sample calls.
+  ``fused_plan`` routes each batch through the ``pallas_fused`` engine
+  (fresh VMEM table per scan step — iterations stay independent).
   """
   def step(carry, inp):
     tab, scr, k = carry
     seeds, n_valid = inp
     k, sub = jax.random.split(k)
     out, tab, scr = multihop_sample(one_hop, seeds, n_valid, fanouts,
-                                    sub, tab, scr, with_edge=with_edge)
+                                    sub, tab, scr, with_edge=with_edge,
+                                    fused_plan=fused_plan)
     return (tab, scr, k), out
 
   (table, scratch, _), outs = jax.lax.scan(

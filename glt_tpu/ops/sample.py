@@ -73,6 +73,45 @@ def _floyd_offsets(deg: jax.Array, u: jax.Array, fanout: int) -> jax.Array:
   return chosen
 
 
+def _draw_hop(indptr, seeds, fanout, key, seed_mask, replace):
+  """The one uniform-hop offset draw shared by EVERY hop engine: degree
+  window, Floyd/replace offsets, validity mask, absolute edge slots.
+  Keeping this in one place is what makes the engines bit-identical —
+  they differ only in WHERE neighbor values are read from."""
+  start = jnp.take(indptr, seeds, mode='clip')
+  end = jnp.take(indptr, seeds + 1, mode='clip')
+  deg = (end - start).astype(jnp.int32)
+  if seed_mask is not None:
+    deg = jnp.where(seed_mask, deg, 0)
+  iota = jnp.arange(fanout, dtype=jnp.int32)[None, :]    # [1, K]
+  if replace:
+    u = jax.random.uniform(key, (seeds.shape[0], fanout))
+    offsets = jnp.minimum((u * deg[:, None]).astype(jnp.int32),
+                          jnp.maximum(deg[:, None] - 1, 0))
+    mask = jnp.broadcast_to(deg[:, None] > 0, offsets.shape)
+  else:
+    u = jax.random.uniform(key, (fanout, seeds.shape[0]))
+    sampled = _floyd_offsets(deg, u, fanout)
+    exhaustive = jnp.broadcast_to(iota, sampled.shape)
+    offsets = jnp.where((deg <= fanout)[:, None], exhaustive, sampled)
+    mask = iota < jnp.minimum(deg, fanout)[:, None]
+  return start, deg, offsets, mask
+
+
+def _hub_fixup_inputs(deg, slots, w_width, n_hub, fanout, s):
+  """Hub row indices + exact edge slots for the Pallas kernels' tail
+  pass (shared by the ``pallas`` and ``pallas_fused`` engines)."""
+  if n_hub > 0 and s > 0:
+    hub_idx = jnp.nonzero(deg > w_width, size=n_hub,
+                          fill_value=-1)[0].astype(jnp.int32)
+    hub_slots = jnp.take(slots, jnp.maximum(hub_idx, 0),
+                         axis=0).astype(jnp.int32)           # [H, K]
+  else:  # static dummy row: -1 never matches a block
+    hub_idx = jnp.full((1,), -1, jnp.int32)
+    hub_slots = jnp.zeros((1, fanout), jnp.int32)
+  return hub_idx, hub_slots
+
+
 def _gather_row_windows(src: jax.Array, start: jax.Array,
                         width: int) -> jax.Array:
   """[S, width] contiguous slice per row: win[s, j] = src[start[s] + j].
@@ -148,6 +187,11 @@ def sample_neighbors(
   assert fanout > 0, 'fanout must be a static positive int'
   if engine is None:
     engine = 'window' if window is not None else 'element'
+  if engine == 'pallas_fused':
+    # the dedup fusion only engages through the pipeline entry point
+    # (FusedHopPlan / multihop_sample); a plain NeighborOutput call
+    # reads windows through the same megakernel machinery as 'pallas'
+    engine = 'pallas'
   assert engine in ('element', 'window', 'pallas'), engine
   if engine == 'element':
     window = None
@@ -158,25 +202,8 @@ def sample_neighbors(
   if num_edges == 0:  # legitimately empty (e.g. a rare-etype partition)
     return _empty_output(seeds.shape[0], fanout, indices, edge_ids,
                          indptr)
-  start = jnp.take(indptr, seeds, mode='clip')
-  end = jnp.take(indptr, seeds + 1, mode='clip')
-  deg = (end - start).astype(jnp.int32)
-  if seed_mask is not None:
-    deg = jnp.where(seed_mask, deg, 0)
-
-  iota = jnp.arange(fanout, dtype=jnp.int32)[None, :]    # [1, K]
-  if replace:
-    u = jax.random.uniform(key, (seeds.shape[0], fanout))
-    offsets = jnp.minimum((u * deg[:, None]).astype(jnp.int32),
-                          jnp.maximum(deg[:, None] - 1, 0))
-    mask = jnp.broadcast_to(deg[:, None] > 0, offsets.shape)
-  else:
-    u = jax.random.uniform(key, (fanout, seeds.shape[0]))
-    sampled = _floyd_offsets(deg, u, fanout)
-    exhaustive = jnp.broadcast_to(iota, sampled.shape)
-    offsets = jnp.where((deg <= fanout)[:, None], exhaustive, sampled)
-    mask = iota < jnp.minimum(deg, fanout)[:, None]
-
+  start, deg, offsets, mask = _draw_hop(indptr, seeds, fanout, key,
+                                        seed_mask, replace)
   slots = jnp.clip(start[:, None] + offsets.astype(start.dtype),
                    0, max(num_edges - 1, 0))
   if window is not None:
@@ -201,14 +228,8 @@ def sample_neighbors(
       assert edge_ids is None or edge_ids_win is not None, (
           'pallas engine with edge_ids needs edge_ids_win (the W-padded '
           'edge-id array, Graph.window_arrays()["edge_ids"])')
-      if n_hub > 0 and seeds.shape[0] > 0:
-        hub_idx = jnp.nonzero(deg > w_width, size=n_hub,
-                              fill_value=-1)[0].astype(jnp.int32)
-        hub_slots = jnp.take(slots, jnp.maximum(hub_idx, 0),
-                             axis=0).astype(jnp.int32)      # [H, K]
-      else:  # static dummy row: -1 never matches a block
-        hub_idx = jnp.full((1,), -1, jnp.int32)
-        hub_slots = jnp.zeros((1, fanout), jnp.int32)
+      hub_idx, hub_slots = _hub_fixup_inputs(deg, slots, w_width, n_hub,
+                                             fanout, seeds.shape[0])
       nbrs, eid_picks = sample_hop(
           indices_win, edge_ids_win if edge_ids is not None else None,
           start.astype(jnp.int32), offsets, hub_idx, hub_slots,
@@ -243,6 +264,179 @@ def sample_neighbors(
   eids = jnp.take(edge_ids, slots, mode='clip') if edge_ids is not None \
       else slots
   return NeighborOutput(nbrs=nbrs, mask=mask, eids=eids)
+
+
+_BIG_I32 = jnp.iinfo(jnp.int32).max
+
+
+def sample_neighbors_fused(
+    indptr: jax.Array,
+    indices: jax.Array,
+    seeds: jax.Array,
+    fanout: int,
+    key: jax.Array,
+    tab_ids: jax.Array,
+    tab_labs: jax.Array,
+    count: jax.Array,
+    seed_mask: Optional[jax.Array] = None,
+    edge_ids: Optional[jax.Array] = None,
+    replace: bool = False,
+    window: Optional[tuple] = None,
+    indices_win: Optional[jax.Array] = None,
+    edge_ids_win: Optional[jax.Array] = None,
+    interpret: bool = False,
+):
+  """One FUSED hop: sample + dedup/relabel in a single kernel pass (the
+  ``pallas_fused`` engine, ops/pipeline.py::hop_engine).
+
+  Sampling offsets come from :func:`_draw_hop` — the same draw as every
+  other engine — and the picks, the ``[S, W]`` windows, and the dedup
+  probes all stay inside ``sample_hop_dedup``'s VMEM. The kernel emits
+  PROVISIONAL labels (first-occurrence order); this wrapper restores
+  the exact :func:`glt_tpu.ops.unique.sorted_hop_dedup_fused` contract
+  — new ids labeled ``count..count+n-1`` in within-hop VALUE order,
+  seen ids keeping their labels — with one single-payload sort over the
+  fresh unique ids, and rewrites the table's labels to match so the
+  NEXT hop's probes return final labels.
+
+  Returns ``(out, d, (tab_ids', tab_labs'))`` where ``out`` is the
+  usual :class:`NeighborOutput` and ``d`` carries (all slot-order,
+  shapes ``[S*K]`` unless noted):
+
+    labels3 / new_head3 / count2 / new_count : exactly
+      ``sorted_hop_dedup_fused``'s fields;
+    sorted_new_ids : [S*K] the fresh unique ids ASCENDING (= label
+      order ``count..count+new_count-1``), _BIG padded — the fused
+      feature gather consumes these directly.
+  """
+  assert fanout > 0, 'fanout must be a static positive int'
+  assert window is not None and indices_win is not None, (
+      'the fused engine always reads through windows; pass window=(W, '
+      'H) and the W-padded indices (Graph.window_arrays)')
+  from .pallas_kernels import sample_hop_dedup
+  w_width, n_hub = window
+  seeds = seeds.astype(indptr.dtype)
+  s = seeds.shape[0]
+  m = s * fanout
+  num_edges = indices.shape[0]
+  if num_edges == 0:  # legitimately empty graph: nothing dedups
+    out = _empty_output(s, fanout, indices, edge_ids, indptr)
+    d = dict(labels3=jnp.full((m,), -1, jnp.int32),
+             new_head3=jnp.zeros((m,), bool),
+             count2=count, new_count=jnp.zeros((), jnp.int32),
+             sorted_new_ids=jnp.full((m,), _BIG_I32, jnp.int32))
+    return out, d, (tab_ids, tab_labs)
+  start, deg, offsets, mask = _draw_hop(indptr, seeds, fanout, key,
+                                        seed_mask, replace)
+  slots = jnp.clip(start[:, None] + offsets.astype(start.dtype),
+                   0, max(num_edges - 1, 0))
+  assert edge_ids is None or edge_ids_win is not None, (
+      'fused engine with edge_ids needs edge_ids_win (the W-padded '
+      'edge-id array, Graph.window_arrays()["edge_ids"])')
+  hub_idx, hub_slots = _hub_fixup_inputs(deg, slots, w_width, n_hub,
+                                         fanout, s)
+  picks, eid_picks, prov, new_head, tab_ids, tab_labs = \
+      sample_hop_dedup(
+          indices_win, edge_ids_win if edge_ids is not None else None,
+          start.astype(jnp.int32), offsets, mask, hub_idx, hub_slots,
+          tab_ids, tab_labs, count, width=w_width, interpret=interpret)
+  eids = eid_picks if edge_ids is not None else slots
+  out = NeighborOutput(nbrs=picks, mask=mask, eids=eids)
+
+  # value-order relabel: kernel labels are first-occurrence ranks; the
+  # sorted_hop_dedup_fused contract ranks fresh ids by VALUE. One
+  # 2-operand sort over [M] — narrower than the engine it replaces
+  # (3 operands over [C+M]) and the only sort left in the fused hop.
+  ids_flat = picks.reshape(-1).astype(jnp.int32)
+  m_flat = mask.reshape(-1)
+  prov_flat = prov.reshape(-1)
+  nh = new_head.reshape(-1) != 0
+  first_rank = jnp.where(nh, prov_flat - count, m)      # pads -> sink
+  new_by_rank = jnp.full((m + 1,), _BIG_I32, jnp.int32).at[
+      first_rank].set(jnp.where(nh, ids_flat, _BIG_I32))[:m]
+  iota = jnp.arange(m, dtype=jnp.int32)
+  sorted_ids, sorted_rank = jax.lax.sort([new_by_rank, iota],
+                                         num_keys=1)
+  val_rank = jnp.zeros((m + 1,), jnp.int32).at[
+      jnp.where(sorted_ids < _BIG_I32, sorted_rank, m)].set(iota)[:m]
+  is_new_el = m_flat & (prov_flat >= count)
+  labels3 = jnp.where(
+      is_new_el,
+      count + jnp.take(val_rank, jnp.clip(prov_flat - count, 0, m - 1)),
+      prov_flat)
+  new_count = nh.sum(dtype=jnp.int32)
+  # table fix-up: this hop's inserts carry provisional labels >= count;
+  # map them through the same rank table so the next hop probes final
+  tab_labs = jnp.where(
+      (tab_ids >= 0) & (tab_labs >= count),
+      count + jnp.take(val_rank, jnp.clip(tab_labs - count, 0, m - 1)),
+      tab_labs)
+  d = dict(labels3=labels3, new_head3=nh, count2=count + new_count,
+           new_count=new_count, sorted_new_ids=sorted_ids)
+  return out, d, (tab_ids, tab_labs)
+
+
+class FusedHopPlan:
+  """Trace-time bundle for the ``pallas_fused`` engine: the graph's
+  window-padded edge arrays, the static window/hub/table geometry, and
+  (optionally) the fused feature-gather closure. Built once per
+  compiled multihop program (sampler/neighbor_sampler.py, bench.py) and
+  consumed by :func:`glt_tpu.ops.pipeline.multihop_sample` — the plan
+  is what routes the hop loop through :func:`sample_neighbors_fused`
+  instead of the ``one_hop`` + sort-dedup pair.
+
+  Args:
+    indptr / indices: the CSR (device-resident).
+    indices_win: W-padded indices (Graph.window_arrays contract).
+    width: window width W.
+    hub_count: the graph's true hub-row count for W (Graph.hub_count) —
+      clamped per hop to the frontier size, like the other engines.
+    table_slots: dedup-table capacity in id slots
+      (pallas_kernels.fused_table_slots(budget); must exceed the walk's
+      node budget so probes terminate).
+    edge_ids / edge_ids_win: optional edge-id plane.
+    gather_fn: optional ``ids [m] -> rows [m, D]`` feature row gather
+      (Feature.fused_gather_fn) — set, the pipeline gathers each hop's
+      fresh rows while the walk is still running and emits
+      ``node_feats`` alongside the sample.
+    feat_dim / feat_dtype: static output geometry for ``gather_fn``.
+  """
+
+  def __init__(self, indptr, indices, indices_win, width, hub_count,
+               table_slots, edge_ids=None, edge_ids_win=None,
+               replace=False, interpret=False, gather_fn=None,
+               feat_dim=None, feat_dtype=None):
+    self.indptr = indptr
+    self.indices = indices
+    self.indices_win = indices_win
+    self.width = int(width)
+    self.hub_count = int(hub_count)
+    self.table_slots = int(table_slots)
+    self.edge_ids = edge_ids
+    self.edge_ids_win = edge_ids_win
+    self.replace = bool(replace)
+    self.interpret = bool(interpret)
+    self.gather_fn = gather_fn
+    self.feat_dim = feat_dim
+    self.feat_dtype = feat_dtype
+
+  def init_table(self, ids, labs, valid):
+    """Fresh table planes seeded with the exact-dedup'd seed hop."""
+    from .pallas_kernels import dedup_table_insert, make_dedup_table
+    tab_ids, tab_labs = make_dedup_table(self.table_slots)
+    return dedup_table_insert(tab_ids, tab_labs, ids, labs, valid,
+                              interpret=self.interpret)
+
+  def __call__(self, frontier_ids, fanout, key, mask, table, count):
+    tab_ids, tab_labs = table
+    out, d, table = sample_neighbors_fused(
+        self.indptr, self.indices, frontier_ids, fanout, key,
+        tab_ids, tab_labs, count, seed_mask=mask,
+        edge_ids=self.edge_ids, replace=self.replace,
+        window=(self.width, min(self.hub_count, frontier_ids.shape[0])),
+        indices_win=self.indices_win, edge_ids_win=self.edge_ids_win,
+        interpret=self.interpret)
+    return out, d, table
 
 
 def sample_full_neighbors(

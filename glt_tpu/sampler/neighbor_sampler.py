@@ -27,9 +27,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..data import Graph
-from ..ops.pipeline import dedup_engine, edge_hop_offsets, \
-    hetero_edge_hop_offsets, hop_engine, make_dedup_tables, \
-    multihop_sample, multihop_sample_hetero
+from ..ops.pipeline import count_engine_fallback, dedup_engine, \
+    edge_hop_offsets, hetero_edge_hop_offsets, hop_engine, \
+    make_dedup_tables, multihop_sample, multihop_sample_hetero, \
+    sample_budget
 from ..ops.sample import (
     neighbor_probs, sample_full_neighbors, sample_neighbors,
     sample_neighbors_weighted,
@@ -70,6 +71,21 @@ class NeighborSampler(BaseSampler):
       path; defaults to the graph's max degree.
     full_neighbor_cap: static neighbor-window bound for ``-1`` hops.
     seed: RNG seed; defaults to the process RandomSeedManager.
+    fused_feature: optional fully-device-resident
+      :class:`~glt_tpu.data.feature.Feature` for the ``pallas_fused``
+      engine's in-walk feature gather: each hop's FRESH unique rows are
+      gathered while the walk runs (through the existing
+      ``gather_rows``/``row_gather`` path) and the assembled
+      ``[budget, D]`` block lands in ``SamplerOutput.metadata
+      ['node_feats']`` — bit-identical to ``gather_features(feat,
+      out.node)``, which downstream call sites short-circuit through
+      (``gather_features(..., fused=)``). The feature block is a
+      compile-time constant of the sampler's programs, so swapping the
+      store (stream snapshot updates) requires a fresh sampler — the
+      stream path therefore never enables this.
+    row_gather: optional gather-kernel override for ``fused_feature``
+      (the ``resolve_row_gather`` seam, same contract as
+      ``Feature.device_gather``).
   """
 
   def __init__(
@@ -84,6 +100,8 @@ class NeighborSampler(BaseSampler):
       seed: Optional[int] = None,
       max_weighted_degree: Optional[int] = None,
       full_neighbor_cap: Optional[int] = None,
+      fused_feature=None,
+      row_gather=None,
   ):
     assert edge_dir in ('out', 'in')
     self.graph = graph
@@ -95,6 +113,9 @@ class NeighborSampler(BaseSampler):
     self.device = device
     self.max_weighted_degree = max_weighted_degree
     self.full_neighbor_cap = full_neighbor_cap
+    self.fused_feature = fused_feature
+    self.row_gather = row_gather
+    self._fallbacks_counted = set()
     from ..utils.rng import make_key
     self._base_key = make_key(
         seed if seed is not None
@@ -194,6 +215,83 @@ class NeighborSampler(BaseSampler):
     return dict(window_gather=lambda arr, st, w: fn(arr, st, width=w),
                 window_sources=sources)
 
+  def _count_fallback(self, reason: str, resolved: str = 'pallas'):
+    """Once-per-(sampler, reason) engine-fallback accounting — the
+    event is a property of the sampler's configuration, so repeating it
+    per hop/call would just inflate the counter."""
+    if reason not in self._fallbacks_counted:
+      self._fallbacks_counted.add(reason)
+      count_engine_fallback('pallas_fused', resolved, reason)
+
+  def _resolved_hop_engine(self) -> str:
+    """The engine this sampler ACTUALLY runs: ``pallas_fused`` demotes
+    to ``pallas`` (counted, ``hop_engine_fallbacks_total``) for the hop
+    shapes the fusion does not serve — hetero traversals (per-edge-type
+    frontiers would each need their own resident table), weighted and
+    full-neighborhood hops (no uniform offset pick to fuse), and a
+    forced dense dedup engine (the fused kernel IS the sort-contract
+    inducer)."""
+    eng = getattr(self, '_hop_engine_override', None) or hop_engine()
+    if eng != 'pallas_fused':
+      return eng
+    if self.is_hetero:
+      self._count_fallback('hetero')
+      return 'pallas'
+    if self.with_weight:
+      self._count_fallback('weighted')
+      return 'pallas'
+    if any(f < 0 for f in self.num_neighbors):
+      self._count_fallback('full_neighborhood')
+      return 'pallas'
+    if os.environ.get('GLT_DEDUP') == 'table':
+      self._count_fallback('dense_dedup_forced')
+      return 'pallas'
+    return eng
+
+  def _fused_plan(self, batch_size: int):
+    """Build the :class:`~glt_tpu.ops.sample.FusedHopPlan` for one
+    compiled multihop program, or None with a counted fallback when the
+    fused engine cannot engage at this shape (HOST-mode edge arrays; a
+    node budget whose dedup table would blow the VMEM sizing knob,
+    ``GLT_FUSED_TABLE_SLOTS``)."""
+    if self._resolved_hop_engine() != 'pallas_fused':
+      return None
+    from ..ops.pallas_kernels import (fused_table_max_slots,
+                                      fused_table_slots,
+                                      interpret_default)
+    from ..ops.sample import FusedHopPlan
+    g: Graph = self.graph
+    width = max(int(os.environ.get('GLT_WINDOW_W', '96')), 8)
+    fields = ('indices', 'edge_ids') if (
+        self.with_edge and g.topo.edge_ids is not None) else ('indices',)
+    # window_arrays BEFORE touching g.indices/edge_ids — the padded
+    # copy supersedes the originals (one-resident-copy rule)
+    sources = g.window_arrays(width, fields)
+    if any(sources.get(f) is None for f in fields):
+      # HOST-mode graphs have no device window arrays at all, so the
+      # demoted hop read lands on the ELEMENT path (the same guard in
+      # _uniform_hop_kwargs returns {})
+      self._count_fallback('host_mode_arrays', resolved='element')
+      return None
+    budget = sample_budget(batch_size, self.num_neighbors)
+    slots = fused_table_slots(budget)
+    if slots > fused_table_max_slots():
+      self._count_fallback('table_overflow')
+      return None
+    gather_fn = feat_dim = feat_dtype = None
+    feat = self.fused_feature
+    if feat is not None and feat.fully_device_resident:
+      gather_fn = feat.fused_gather_fn(row_gather=self.row_gather)
+      feat_dim = feat.feature_dim
+      feat_dtype = feat.device_part.dtype
+    return FusedHopPlan(
+        g.indptr, g.indices, sources['indices'], width,
+        g.hub_count(width), slots,
+        edge_ids=g.edge_ids if self.with_edge else None,
+        edge_ids_win=sources.get('edge_ids'), replace=self.replace,
+        interpret=interpret_default(), gather_fn=gather_fn,
+        feat_dim=feat_dim, feat_dtype=feat_dtype)
+
   def _uniform_hop_kwargs(self, g: Graph, frontier_size: int):
     """Windowed-engine plumbing for the UNIFORM hop read
     (ops/pipeline.py::hop_engine, read at trace time): resolves the
@@ -202,8 +300,13 @@ class NeighborSampler(BaseSampler):
     (:meth:`Graph.hub_count` — host-side, once per width), and the
     W-padded edge arrays. Returns {} on the element engine or when the
     padded arrays are unavailable (HOST-mode graphs). Tests inject an
-    engine/interpret override via ``_hop_engine_override``."""
-    eng = getattr(self, '_hop_engine_override', None) or hop_engine()
+    engine/interpret override via ``_hop_engine_override``. A
+    ``pallas_fused`` request reaching THIS path (a hop shape outside
+    the fused plan — hetero, weighted/full companions, plan fallback)
+    reads windows through the plain ``pallas`` megakernel."""
+    eng = self._resolved_hop_engine()
+    if eng == 'pallas_fused':
+      eng = 'pallas'
     if eng == 'element':
       return {}
     width = max(int(os.environ.get('GLT_WINDOW_W', '96')), 8)
@@ -260,11 +363,13 @@ class NeighborSampler(BaseSampler):
     g: Graph = self.graph
     one_hop = lambda ids, fanout, key, mask: self._one_hop(
         g, ids, fanout, key, mask)
+    fused_plan = self._fused_plan(batch_size)
 
     def fn(seeds, n_valid, key, table, scratch):
       return multihop_sample(one_hop, seeds, n_valid, self.num_neighbors,
                              key, table, scratch,
-                             with_edge=self.with_edge)
+                             with_edge=self.with_edge,
+                             fused_plan=fused_plan)
 
     return jax.jit(fn, donate_argnums=(3, 4))
 
@@ -301,6 +406,13 @@ class NeighborSampler(BaseSampler):
           kwargs.get('key', self._next_key()), table, scratch)
       _synced['out'] = out['num_sampled_edges']
     self._tables[''] = (table, scratch)
+    metadata = {'seed_labels': out['seed_labels'],
+                'seed_count': out['seed_count']}
+    if 'node_feats' in out:
+      # the fused in-walk gather (pallas_fused + fused_feature):
+      # bit-identical to gather_features(feat, node) — consumers
+      # short-circuit through gather_features(..., fused=...)
+      metadata['node_feats'] = out['node_feats']
     return SamplerOutput(
         node=out['node'], node_count=out['node_count'],
         row=out['row'], col=out['col'], edge_mask=out['edge_mask'],
@@ -308,8 +420,7 @@ class NeighborSampler(BaseSampler):
         num_sampled_nodes=out['num_sampled_nodes'],
         num_sampled_edges=out['num_sampled_edges'],
         edge_hop_offsets=self._edge_hop_offsets(batch_size),
-        metadata={'seed_labels': out['seed_labels'],
-                  'seed_count': out['seed_count']},
+        metadata=metadata,
     )
 
   # -- heterogeneous sampling -------------------------------------------

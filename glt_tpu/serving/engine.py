@@ -171,8 +171,11 @@ class InferenceEngine:
     it (public so param init / benchmarks build batches through the
     same pipeline instead of re-rolling it)."""
     out = self.sampler.sample_from_nodes(seeds, n_valid=n_valid)
+    # a pallas_fused sampler built with fused_feature= hands the rows
+    # back pre-gathered (in-walk); gather_features passes them through
     x = gather_features(self.data.get_node_feature(), out.node,
-                        row_gather=self.row_gather)
+                        row_gather=self.row_gather,
+                        fused=(out.metadata or {}).get('node_feats'))
     # metadata carries per-call arrays (seed labels) — stripping it
     # keeps the forward's pytree signature identical across calls
     return to_batch(out, x=x, batch_size=bucket).replace(metadata=None)

@@ -182,6 +182,16 @@ class StreamSampler(BaseSampler):
     source: every valid window needs ``start + W <= capacity``, i.e.
     padding slack >= W (starts never exceed the live edge count)."""
     eng = getattr(self, '_hop_engine_override', None) or hop_engine()
+    if eng == 'pallas_fused':
+      # delta hops interleave base picks with tombstone masks and
+      # insert-overlay expansion — the VMEM dedup table can't sit
+      # across that merge, so the stream path rides the plain pallas
+      # megakernel for its base reads (counted, once per sampler)
+      if not getattr(self, '_fused_fallback_counted', False):
+        self._fused_fallback_counted = True
+        from ..ops.pipeline import count_engine_fallback
+        count_engine_fallback('pallas_fused', 'pallas', 'stream_overlay')
+      eng = 'pallas'
     if eng == 'element' or not any(f > 0 for f in self._base_fanouts):
       return ('element', 0, 0)
     width = max(int(os.environ.get('GLT_WINDOW_W', '96')), 8)

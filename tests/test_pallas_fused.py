@@ -1,0 +1,379 @@
+"""The `pallas_fused` hop engine (ops/pallas_kernels.py::
+sample_hop_dedup + dedup_table_insert, routed via
+ops/sample.py::FusedHopPlan).
+
+Acceptance contract (ISSUE 10): the fused sample+dedup(+gather)
+pipeline is BIT-IDENTICAL to the `sort+fused` engine (GLT_DEDUP=sort
+GLT_FUSED_HOP=1) in interpret mode — same labels (new ids in within-hop
+value order, seed hop exact), same node list, same counts — with the
+documented exception that `edge`/`nbrs` values on MASKED-OUT lanes are
+undefined per engine (same contract as tests/test_pallas_hop.py; full
+equality holds against a window-read reference, which reads the same
+physical slots). Zero steady-state recompiles must hold with the
+engine forced, for the plain sampler, the serving engine, and the
+stream sampler (which falls back to `pallas` for its overlay hops,
+counted in hop_engine_fallbacks_total).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from glt_tpu.data import Topology
+from glt_tpu.ops.pipeline import (make_dedup_tables, multihop_sample,
+                                  multihop_sample_many, sample_budget)
+from glt_tpu.ops.sample import FusedHopPlan, sample_neighbors
+from glt_tpu.ops.pallas_kernels import fused_table_slots
+
+from fixtures import ring_dataset
+
+pytestmark = pytest.mark.pallas
+
+W = 8
+
+EXACT_KEYS = ('node', 'node_count', 'row', 'col', 'edge_mask', 'batch',
+              'seed_labels', 'seed_count', 'num_sampled_nodes',
+              'num_sampled_edges')
+
+
+def _graph(n=64, e=600, seed=0):
+  rng = np.random.default_rng(seed)
+  src = rng.integers(0, n, e)
+  dst = rng.integers(0, n, e)
+  t = Topology(edge_index=np.stack([src, dst]), num_nodes=n)
+  indptr = jnp.asarray(t.indptr.astype(np.int32))
+  indices = jnp.asarray(t.indices)
+  iw = jnp.concatenate([indices, jnp.full((W,), -1, indices.dtype)])
+  eids = jnp.arange(indices.shape[0], dtype=jnp.int32) * 3
+  ew = jnp.concatenate([eids, jnp.full((W,), -1, eids.dtype)])
+  n_hub = int((np.diff(t.indptr) > W).sum())
+  return dict(n=n, topo=t, indptr=indptr, indices=indices, iw=iw,
+              eids=eids, ew=ew, n_hub=n_hub)
+
+
+def _plan(g, fanouts, batch, with_edge=False, replace=False,
+          **gather_kw):
+  return FusedHopPlan(
+      g['indptr'], g['indices'], g['iw'], W, g['n_hub'],
+      fused_table_slots(sample_budget(batch, list(fanouts))),
+      edge_ids=g['eids'] if with_edge else None,
+      edge_ids_win=g['ew'] if with_edge else None,
+      replace=replace, interpret=True, **gather_kw)
+
+
+def _ref_sort_fused(g, seeds, nv, fanouts, key, monkeypatch,
+                    with_edge=False, window_read=False, replace=False):
+  """The reference engine: GLT_DEDUP=sort + GLT_FUSED_HOP=1.
+  window_read=True reads neighbor values through the same padded
+  windows as the kernel, making even masked-lane junk identical."""
+  monkeypatch.setenv('GLT_DEDUP', 'sort')
+  monkeypatch.setenv('GLT_FUSED_HOP', '1')
+  kw = {}
+  if window_read:
+    kw = dict(window=(W, None), indices_win=g['iw'],
+              edge_ids_win=g['ew'] if with_edge else None,
+              engine='window')
+  def one_hop(ids, f, k, m):
+    w = dict(kw)
+    if window_read:
+      w['window'] = (W, min(g['n_hub'], ids.shape[0]))
+    return sample_neighbors(
+        g['indptr'], g['indices'], ids, f, k, seed_mask=m,
+        edge_ids=g['eids'] if with_edge else None, replace=replace, **w)
+  table, scratch = make_dedup_tables(g['n'])
+  out, _, _ = multihop_sample(one_hop, seeds, nv, fanouts, key, table,
+                              scratch, with_edge=with_edge)
+  monkeypatch.delenv('GLT_DEDUP')
+  monkeypatch.delenv('GLT_FUSED_HOP')
+  return jax.tree.map(np.asarray, out)
+
+
+@pytest.mark.parametrize('with_edge', [False, True])
+@pytest.mark.parametrize('fanouts', [(3,), (3, 2)])
+def test_multihop_bit_identical_to_sort_fused(monkeypatch, fanouts,
+                                              with_edge):
+  g = _graph()
+  seeds = jnp.asarray(np.array([5, 0, 5, 17, 63, 2, 2, 9], np.int32))
+  nv = jnp.asarray(7)
+  key = jax.random.key(0)
+  ref = _ref_sort_fused(g, seeds, nv, fanouts, key, monkeypatch,
+                        with_edge=with_edge)
+  table, scratch = make_dedup_tables(g['n'])
+  got, _, _ = multihop_sample(
+      None, seeds, nv, fanouts, key, table, scratch,
+      with_edge=with_edge,
+      fused_plan=_plan(g, fanouts, seeds.shape[0], with_edge=with_edge))
+  for k in EXACT_KEYS:
+    np.testing.assert_array_equal(ref[k], np.asarray(got[k]),
+                                  err_msg=k)
+  if with_edge:
+    m = ref['edge_mask'].astype(bool)
+    np.testing.assert_array_equal(ref['edge'][m],
+                                  np.asarray(got['edge'])[m])
+
+
+def test_edge_full_parity_vs_window_reference(monkeypatch):
+  # against a window-read reference even the masked-lane junk matches:
+  # both engines read the same physical window slots
+  g = _graph(seed=3)
+  seeds = jnp.asarray(np.arange(10, dtype=np.int32))
+  nv = jnp.asarray(10)
+  key = jax.random.key(1)
+  fanouts = (3, 2)
+  ref = _ref_sort_fused(g, seeds, nv, fanouts, key, monkeypatch,
+                        with_edge=True, window_read=True)
+  table, scratch = make_dedup_tables(g['n'])
+  got, _, _ = multihop_sample(
+      None, seeds, nv, fanouts, key, table, scratch, with_edge=True,
+      fused_plan=_plan(g, fanouts, seeds.shape[0], with_edge=True))
+  np.testing.assert_array_equal(ref['edge'], np.asarray(got['edge']))
+
+
+def test_replace_and_empty_frontier(monkeypatch):
+  g = _graph(seed=5)
+  fanouts = (4,)
+  seeds = jnp.asarray(np.array([1, 2, 3, 4], np.int32))
+  key = jax.random.key(2)
+  # sampling WITH replacement
+  ref = _ref_sort_fused(g, seeds, jnp.asarray(4), fanouts, key,
+                        monkeypatch, replace=True)
+  table, scratch = make_dedup_tables(g['n'])
+  got, _, _ = multihop_sample(
+      None, seeds, jnp.asarray(4), fanouts, key, table, scratch,
+      fused_plan=_plan(g, fanouts, 4, replace=True))
+  for k in EXACT_KEYS:
+    np.testing.assert_array_equal(ref[k], np.asarray(got[k]), err_msg=k)
+  # fully-masked batch (n_valid = 0): every surface empty/-1, both
+  ref0 = _ref_sort_fused(g, seeds, jnp.asarray(0), fanouts, key,
+                         monkeypatch)
+  got0, _, _ = multihop_sample(
+      None, seeds, jnp.asarray(0), fanouts, key, table, scratch,
+      fused_plan=_plan(g, fanouts, 4))
+  for k in EXACT_KEYS:
+    np.testing.assert_array_equal(ref0[k], np.asarray(got0[k]),
+                                  err_msg=k)
+  assert int(got0['node_count']) == 0
+
+
+def test_multihop_many_scan_parity(monkeypatch):
+  # the lax.scan entry point (bench scan>1): fresh VMEM table per scan
+  # step, results identical to per-batch fused calls
+  g = _graph(seed=7)
+  fanouts = (3, 2)
+  seeds = jnp.asarray(
+      np.random.default_rng(0).integers(0, g['n'], (3, 6)).astype(
+          np.int32))
+  nv = jnp.full((3,), 6, jnp.int32)
+  key = jax.random.key(4)
+  plan = _plan(g, fanouts, 6)
+  table, scratch = make_dedup_tables(g['n'])
+  outs, _, _ = multihop_sample_many(None, seeds, nv, fanouts, key,
+                                    table, scratch, fused_plan=plan)
+  k = key
+  for t in range(3):
+    k, sub = jax.random.split(k)
+    one, _, _ = multihop_sample(None, seeds[t], nv[t], fanouts, sub,
+                                table, scratch, fused_plan=plan)
+    np.testing.assert_array_equal(np.asarray(outs['node'])[t],
+                                  np.asarray(one['node']))
+    np.testing.assert_array_equal(np.asarray(outs['row'])[t],
+                                  np.asarray(one['row']))
+
+
+# -- sampler / serving / stream wiring ----------------------------------
+
+def test_sampler_forced_engine_parity_and_zero_recompiles(monkeypatch):
+  from glt_tpu.sampler import NeighborSampler
+  ds = ring_dataset(num_nodes=40)
+  monkeypatch.setenv('GLT_DEDUP', 'sort')
+  monkeypatch.setenv('GLT_FUSED_HOP', '1')
+  seeds = np.arange(8)
+  base = NeighborSampler(ds.get_graph(), [3, 2], seed=0,
+                         with_edge=True).sample_from_nodes(seeds)
+  monkeypatch.delenv('GLT_DEDUP')
+  monkeypatch.delenv('GLT_FUSED_HOP')
+  monkeypatch.setenv('GLT_HOP_ENGINE', 'pallas_fused')
+  monkeypatch.setenv('GLT_WINDOW_W', '8')
+  samp = NeighborSampler(ds.get_graph(), [3, 2], seed=0, with_edge=True)
+  out = samp.sample_from_nodes(seeds)
+  for f in ('node', 'row', 'col', 'edge_mask', 'batch'):
+    np.testing.assert_array_equal(np.asarray(getattr(base, f)),
+                                  np.asarray(getattr(out, f)),
+                                  err_msg=f)
+  m = np.asarray(base.edge_mask).astype(bool)
+  np.testing.assert_array_equal(np.asarray(base.edge)[m],
+                                np.asarray(out.edge)[m])
+  assert samp.num_compiled_fns == 1
+  for _ in range(3):   # steady state: the one program serves every call
+    samp.sample_from_nodes(seeds)
+  assert samp.num_compiled_fns == 1
+
+
+def test_two_batch_shapes_share_the_padded_arrays(monkeypatch):
+  # regression mirror of test_pallas_hop: window_arrays must stay
+  # concrete across two trace-time plan builds over the same graph
+  from glt_tpu.sampler import NeighborSampler
+  monkeypatch.setenv('GLT_HOP_ENGINE', 'pallas_fused')
+  monkeypatch.setenv('GLT_WINDOW_W', '8')
+  ds = ring_dataset(num_nodes=40)
+  samp = NeighborSampler(ds.get_graph(), [3, 2], seed=0)
+  out4 = samp.sample_from_nodes(np.arange(4))    # trace 1
+  out8 = samp.sample_from_nodes(np.arange(8))    # trace 2: same graph
+  assert samp.num_compiled_fns == 2
+  assert int(out4.node_count) > 0 and int(out8.node_count) > 0
+
+
+def test_fused_gather_matches_gather_features(monkeypatch):
+  # in-walk gather == post-hoc gather_features, EVERY lane including
+  # the -1 padding, and the row_gather override rides the fused path
+  from glt_tpu.data.feature import gather_features
+  from glt_tpu.sampler import NeighborSampler
+  monkeypatch.setenv('GLT_HOP_ENGINE', 'pallas_fused')
+  monkeypatch.setenv('GLT_WINDOW_W', '8')
+  ds = ring_dataset(num_nodes=40)
+  feat = ds.get_node_feature()
+  calls = {'n': 0}
+
+  def counting_row_gather(table, rows):
+    calls['n'] += 1  # trace-time counter: the override must be USED
+    return jnp.take(table, jnp.clip(rows, 0, table.shape[0] - 1),
+                    axis=0)
+
+  samp = NeighborSampler(ds.get_graph(), [3, 2], seed=0,
+                         fused_feature=feat,
+                         row_gather=counting_row_gather)
+  out = samp.sample_from_nodes(np.arange(8))
+  assert calls['n'] > 0, 'row_gather override never reached'
+  fused_x = out.metadata['node_feats']
+  ref_x = gather_features(feat, out.node)
+  np.testing.assert_array_equal(np.asarray(ref_x), np.asarray(fused_x))
+
+
+def test_serving_engine_fused_parity_and_zero_recompiles(monkeypatch):
+  # the serving call site composes: a fused sampler's node_feats ride
+  # gather_features(fused=) into the bucket pipeline; embeddings match
+  # the sort+fused engine and warmup compiles stay flat
+  from glt_tpu.serving import InferenceEngine
+  from glt_tpu.sampler import NeighborSampler
+  ds = ring_dataset(num_nodes=40)
+  apply_fn = lambda params, batch: batch.x[:, :4] * 2.0
+
+  monkeypatch.setenv('GLT_DEDUP', 'sort')
+  monkeypatch.setenv('GLT_FUSED_HOP', '1')
+  base = InferenceEngine(ds, model=None, params={}, num_neighbors=[3, 2],
+                         buckets=(8,), apply_fn=apply_fn, seed=0,
+                         cache_capacity=0)
+  base.warmup()
+  want = base.infer(np.arange(6))
+  monkeypatch.delenv('GLT_DEDUP')
+  monkeypatch.delenv('GLT_FUSED_HOP')
+
+  monkeypatch.setenv('GLT_HOP_ENGINE', 'pallas_fused')
+  monkeypatch.setenv('GLT_WINDOW_W', '8')
+  samp = NeighborSampler(ds.get_graph(), [3, 2], seed=0,
+                         fused_feature=ds.get_node_feature())
+  eng = InferenceEngine(ds, model=None, params={}, num_neighbors=[3, 2],
+                        buckets=(8,), apply_fn=apply_fn,
+                        sampler=samp, cache_capacity=0)
+  eng.warmup()
+  got = eng.infer(np.arange(6))
+  np.testing.assert_array_equal(want, got)
+  stats = eng.compile_stats()
+  for _ in range(4):
+    eng.infer(np.arange(6))
+  assert eng.compile_stats()['forward_traces'] == \
+      stats['forward_traces']
+  assert eng.compile_stats()['sampler_compiled_fns'] == \
+      stats['sampler_compiled_fns']
+
+
+def test_stream_forced_engine_fallback_parity_and_counter(monkeypatch):
+  # forcing pallas_fused on the stream path must keep working (counted
+  # demotion to pallas for the overlay hops) with zero steady-state
+  # recompiles across overlay refreshes and snapshot swaps
+  from glt_tpu.obs import MetricsRegistry, get_registry, set_registry
+  from glt_tpu.stream import (EdgeDeltaBuffer, SnapshotManager,
+                              StreamSampler)
+  prev = set_registry(MetricsRegistry())
+  try:
+    N = 24
+    ds = ring_dataset(num_nodes=N)
+    mgr = SnapshotManager(ds.get_graph().topo, ds.get_node_feature(),
+                          delta_capacity=64)
+    seeds = np.arange(6)
+    # pin the base to the sorted inducer: forcing pallas_fused implies
+    # the sort dedup contract, and the sorted EXACT path permutes edge
+    # tuples within a hop block vs the table engine (documented) — the
+    # comparison must be like-for-like
+    monkeypatch.setenv('GLT_DEDUP', 'sort')
+    base = StreamSampler(mgr, [3, 2], seed=0).sample_from_nodes(seeds)
+    monkeypatch.setenv('GLT_HOP_ENGINE', 'pallas_fused')
+    monkeypatch.setenv('GLT_WINDOW_W', '8')
+    samp = StreamSampler(mgr, [3, 2], seed=0)
+    out = samp.sample_from_nodes(seeds)
+    for f in ('node', 'row', 'col', 'edge_mask', 'batch'):
+      np.testing.assert_array_equal(np.asarray(getattr(base, f)),
+                                    np.asarray(getattr(out, f)),
+                                    err_msg=f)
+    fb = get_registry().get('hop_engine_fallbacks_total',
+                            requested='pallas_fused',
+                            resolved='pallas', reason='stream_overlay')
+    assert fb == 1.0
+    buf = EdgeDeltaBuffer(capacity=16, num_nodes=N)
+    buf.insert_edges([1, 2], [5, 6])
+    samp.refresh_overlay(buf)
+    traces, fns = samp.trace_count, samp.num_compiled_fns
+    for _ in range(3):
+      samp.sample_from_nodes(seeds)
+    mgr.compact(buf.drain())        # swap: same static shapes
+    samp.clear_overlay()
+    samp.sample_from_nodes(seeds)
+    assert samp.trace_count == traces
+    assert samp.num_compiled_fns == fns
+    # the demotion is counted once per sampler, not per call
+    assert get_registry().get('hop_engine_fallbacks_total',
+                              requested='pallas_fused',
+                              resolved='pallas',
+                              reason='stream_overlay') == 1.0
+  finally:
+    set_registry(prev)
+
+
+def test_fallback_counters_for_unservable_shapes(monkeypatch):
+  from glt_tpu.obs import MetricsRegistry, get_registry, set_registry
+  from glt_tpu.sampler import NeighborSampler
+  prev = set_registry(MetricsRegistry())
+  try:
+    monkeypatch.setenv('GLT_HOP_ENGINE', 'pallas_fused')
+    monkeypatch.setenv('GLT_WINDOW_W', '8')
+    ds = ring_dataset(num_nodes=40)
+    # weighted sampling cannot fuse
+    NeighborSampler(ds.get_graph(), [3], seed=0,
+                    with_weight=True).sample_from_nodes(np.arange(4))
+    assert get_registry().get('hop_engine_fallbacks_total',
+                              requested='pallas_fused',
+                              resolved='pallas', reason='weighted') == 1
+    # a dedup table past the VMEM sizing knob cannot fuse — but the
+    # demoted engine still samples correctly
+    monkeypatch.setenv('GLT_FUSED_TABLE_SLOTS', '512')
+    samp = NeighborSampler(ds.get_graph(), [3, 2], seed=0)
+    out = samp.sample_from_nodes(np.arange(8))
+    assert int(out.node_count) > 0
+    assert get_registry().get('hop_engine_fallbacks_total',
+                              requested='pallas_fused',
+                              resolved='pallas',
+                              reason='table_overflow') == 1
+  finally:
+    set_registry(prev)
+
+
+def test_hop_engine_knob_accepts_pallas_fused(monkeypatch):
+  from glt_tpu.ops.pipeline import dedup_engine, hop_engine
+  monkeypatch.setenv('GLT_HOP_ENGINE', 'pallas_fused')
+  assert hop_engine() in ('pallas_fused', 'window')
+  # the fused engine implies the sort dedup contract under auto
+  monkeypatch.delenv('GLT_DEDUP', raising=False)
+  assert dedup_engine() == 'sort'
+  monkeypatch.setenv('GLT_HOP_ENGINE', 'warp')
+  with pytest.raises(ValueError):
+    hop_engine()
