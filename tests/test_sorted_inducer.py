@@ -197,3 +197,147 @@ def test_cumsum_i32_exact():
     x = rng.integers(0, 3, m).astype(np.int32)
     got = np.asarray(jax.jit(cumsum_i32)(jnp.asarray(x)))
     np.testing.assert_array_equal(got, np.cumsum(x))
+
+
+# -- sorted_hop_dedup_fused vs sorted_hop_dedup: adversarial inputs -----
+#
+# The fused variant relaxes ONE property (new labels in within-hop VALUE
+# order instead of first-occurrence slot order); everything else —
+# counts, seen-id labels, the label<->id bijection, exactly-one-head-
+# per-new-id — must hold bit-for-bit on the inputs most likely to break
+# a single-sort formulation: all-duplicate hops, empty frontiers,
+# hub-only frontiers (few distinct ids, massive duplication), and a
+# seen set landing EXACTLY on its capacity.
+
+def _dedup_pair(u_ids, u_labs, count, ids, valid):
+  from glt_tpu.ops.unique import sorted_hop_dedup_fused
+  u_ids = jnp.asarray(u_ids, jnp.int32)
+  u_labs = jnp.asarray(u_labs, jnp.int32)
+  count = jnp.asarray(count, jnp.int32)
+  ids = jnp.asarray(ids, jnp.int32)
+  valid = jnp.asarray(valid, bool)
+  exact = sorted_hop_dedup(u_ids, u_labs, count, ids, valid)
+  fused = sorted_hop_dedup_fused(u_ids, u_labs, count, ids, valid)
+  return (jax.tree.map(np.asarray, exact), jax.tree.map(np.asarray, fused))
+
+
+def _assert_fused_parity(exact, fused, ids, valid, count, budget):
+  ids = np.asarray(ids)
+  valid = np.asarray(valid)
+  m = ids.shape[0]
+  assert int(exact['count2']) == int(fused['count2'])
+  assert int(exact['new_count']) == int(fused['new_count'])
+  # exact path returns per-element arrays permuted; map back via pos3
+  exact_slot_labels = np.full((m,), -1, np.int64)
+  exact_slot_labels[exact['pos3']] = exact['labels3']
+  # seen ids (label < count) keep labels bit-identically; new ids may
+  # permute within the hop but must stay a consistent bijection
+  seen = valid & (exact_slot_labels >= 0) & (exact_slot_labels < count)
+  np.testing.assert_array_equal(exact_slot_labels[seen],
+                                fused['labels3'][seen])
+  np.testing.assert_array_equal(fused['labels3'][~valid],
+                                np.full((~valid).sum(), -1))
+  # exactly one head per new id, placed on a slot holding that id
+  nh = fused['new_head3']
+  assert nh.sum() == int(fused['new_count'])
+  head_ids = ids[nh]
+  assert len(set(head_ids.tolist())) == len(head_ids)
+  # bijection: every valid slot of one id maps to ONE label, ascending
+  # label order == ascending id order for the new ids (value order)
+  new_pairs = sorted(zip(fused['labels3'][nh].tolist(),
+                         head_ids.tolist()))
+  assert [p[1] for p in new_pairs] == sorted(head_ids.tolist())
+  for lab, _id in new_pairs:
+    sel = valid & (ids == _id)
+    assert (fused['labels3'][sel] == lab).all()
+  # both seen-set forms reconstruct the same dense node list
+  na = sorted_nodes_by_label(jnp.asarray(exact['u_ids2']),
+                             jnp.asarray(exact['u_labs2']),
+                             jnp.asarray(exact['count2']), budget)
+  nf = sorted_nodes_by_label(jnp.asarray(fused['u_ids2']),
+                             jnp.asarray(fused['u_labs2']),
+                             jnp.asarray(fused['count2']), budget)
+  cnt = int(exact['count2'])
+  assert set(np.asarray(na)[:cnt].tolist()) == \
+      set(np.asarray(nf)[:cnt].tolist())
+  assert (np.asarray(na)[cnt:] == -1).all()
+  assert (np.asarray(nf)[cnt:] == -1).all()
+
+
+def test_fused_dedup_all_duplicate_hop():
+  # every element the SAME fresh id: one new label, one head, the rest
+  # resolve to it; a masked copy must not create a second head
+  u_ids = np.array([50, 60], np.int32)
+  u_labs = np.array([0, 1], np.int32)
+  ids = np.full((16,), 7, np.int32)
+  valid = np.ones((16,), bool)
+  valid[3] = False
+  exact, fused = _dedup_pair(u_ids, u_labs, 2, ids, valid)
+  _assert_fused_parity(exact, fused, ids, valid, 2, budget=8)
+  assert int(fused['new_count']) == 1
+  # the head sits on the FIRST valid slot (first-occurrence contract)
+  assert fused['new_head3'].argmax() == 0
+
+
+def test_fused_dedup_all_duplicate_of_seen_id():
+  # all-duplicate hop of an id the seen set already holds: zero new
+  # labels, zero heads, every valid slot returns the stored label
+  u_ids = np.array([7, 9], np.int32)
+  u_labs = np.array([0, 1], np.int32)
+  ids = np.full((12,), 9, np.int32)
+  valid = np.ones((12,), bool)
+  exact, fused = _dedup_pair(u_ids, u_labs, 2, ids, valid)
+  _assert_fused_parity(exact, fused, ids, valid, 2, budget=4)
+  assert int(fused['new_count']) == 0
+  assert (fused['labels3'] == 1).all()
+
+
+def test_fused_dedup_empty_frontier():
+  # fully-masked hop (the n_valid=0 batch): nothing changes
+  u_ids = np.array([3], np.int32)
+  u_labs = np.array([0], np.int32)
+  ids = np.array([5, 6, 7, 5], np.int32)
+  valid = np.zeros((4,), bool)
+  exact, fused = _dedup_pair(u_ids, u_labs, 1, ids, valid)
+  _assert_fused_parity(exact, fused, ids, valid, 1, budget=4)
+  assert int(fused['new_count']) == 0
+  assert (fused['labels3'] == -1).all()
+  assert not fused['new_head3'].any()
+
+
+def test_fused_dedup_hub_frontier():
+  # a frontier made entirely of hub expansions: FEW distinct ids, each
+  # repeated many times, half already seen — worst case for head
+  # detection and run grouping
+  rng = np.random.default_rng(0)
+  hubs = np.array([100, 200, 300, 400], np.int32)
+  u_ids = np.array([100, 200], np.int32)     # two hubs already seen
+  u_labs = np.array([0, 1], np.int32)
+  ids = rng.choice(hubs, size=64).astype(np.int32)
+  valid = rng.random(64) < 0.8
+  exact, fused = _dedup_pair(u_ids, u_labs, 2, ids, valid)
+  _assert_fused_parity(exact, fused, ids, valid, 2, budget=8)
+
+
+def test_fused_dedup_capacity_exactly_full():
+  # the seen set lands EXACTLY on the node budget: every label in
+  # [0, budget) assigned, reconstruction leaves no -1 padding, and the
+  # next hop (all-seen) must still resolve every label correctly
+  budget = 8
+  u_ids = np.array([10, 11, 12], np.int32)
+  u_labs = np.array([0, 1, 2], np.int32)
+  ids = np.array([20, 21, 22, 23, 24, 20, 21, 24], np.int32)  # 5 new
+  valid = np.ones((8,), bool)
+  exact, fused = _dedup_pair(u_ids, u_labs, 3, ids, valid)
+  _assert_fused_parity(exact, fused, ids, valid, 3, budget=budget)
+  assert int(fused['count2']) == budget
+  nodes = np.asarray(sorted_nodes_by_label(
+      jnp.asarray(fused['u_ids2']), jnp.asarray(fused['u_labs2']),
+      jnp.asarray(fused['count2']), budget))
+  assert (nodes >= 0).all()
+  # follow-up hop over the full table: all seen, labels exact
+  exact2, fused2 = _dedup_pair(fused['u_ids2'], fused['u_labs2'],
+                               budget, ids, valid)
+  _assert_fused_parity(exact2, fused2, ids, valid, budget,
+                       budget=budget)
+  assert int(fused2['new_count']) == 0
