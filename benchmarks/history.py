@@ -1,0 +1,181 @@
+"""Bench trajectory: an append-only JSONL of every bench run, keyed
+(bench, engine, scale, device).
+
+The BENCH_r0x.json snapshots record *rounds*; nothing compares run N
+to run N-1, so a silent 30% throughput regression between rounds reads
+as weather. This module is the memory: every bench emission appends
+one row per measured series, and ``scripts/bench_compare.py`` gates a
+fresh run against the recorded baseline (noise-aware: median of the
+last N runs with a relative threshold).
+
+Row shape (one JSON object per line)::
+
+    {"ts": ..., "bench": "sampler_engine", "engine": "sort+fused",
+     "scale": "N100000_E1000000_B1024_S4", "device": "cpu",
+     "value": 1234567.8, "unit": "edges/s", ...extra}
+
+Key contract: rows compare ONLY within an exact (bench, engine, scale,
+device) match — a CPU smoke row never baselines a TPU headline, and a
+batch-1024 row never baselines batch-256.
+
+CLI (what CI's regression-gate step runs)::
+
+    python benchmarks/history.py append --history bench_history.jsonl \
+        --bench-json bench_smoke.json
+    python benchmarks/history.py show --history bench_history.jsonl
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+from typing import List, Optional
+
+
+def run_key(row: dict) -> tuple:
+  return (str(row.get('bench', '')), str(row.get('engine', '')),
+          str(row.get('scale', '')), str(row.get('device', '')))
+
+
+def append_run(path: str, bench: str, value: float, unit: str = '',
+               engine: str = '', scale: str = '', device: str = '',
+               ts: Optional[float] = None, **extra) -> dict:
+  """Append one run row; creates the file (and parents) on first use."""
+  row = {
+      'ts': float(ts if ts is not None else time.time()),
+      'bench': str(bench),
+      'engine': str(engine),
+      'scale': str(scale),
+      'device': str(device),
+      'value': float(value),
+      'unit': str(unit),
+  }
+  row.update(extra)
+  parent = os.path.dirname(os.path.abspath(path))
+  os.makedirs(parent, exist_ok=True)
+  with open(path, 'a') as f:
+    f.write(json.dumps(row, sort_keys=True) + '\n')
+  return row
+
+
+def load_runs(path: str, bench: Optional[str] = None,
+              engine: Optional[str] = None,
+              scale: Optional[str] = None,
+              device: Optional[str] = None) -> List[dict]:
+  """All rows (append order == time order), optionally filtered.
+  Malformed lines are skipped — a truncated write from a killed run
+  must not poison the whole trajectory."""
+  if not os.path.exists(path):
+    return []
+  out = []
+  with open(path) as f:
+    for line in f:
+      line = line.strip()
+      if not line:
+        continue
+      try:
+        row = json.loads(line)
+      except ValueError:
+        continue
+      if bench is not None and row.get('bench') != bench:
+        continue
+      if engine is not None and row.get('engine') != engine:
+        continue
+      if scale is not None and row.get('scale') != scale:
+        continue
+      if device is not None and row.get('device') != device:
+        continue
+      out.append(row)
+  return out
+
+
+def baseline(runs: List[dict], median_of: int = 5) -> Optional[float]:
+  """Noise-aware baseline: the median of the last ``median_of`` run
+  values (None when there are none). Median, not mean/max: one noisy
+  CI runner in the window must not move the bar."""
+  vals = [float(r['value']) for r in runs[-max(int(median_of), 1):]
+          if isinstance(r.get('value'), (int, float))]
+  if not vals:
+    return None
+  return statistics.median(vals)
+
+
+def rows_from_bench_json(doc: dict, device: Optional[str] = None,
+                         scale: Optional[str] = None) -> List[dict]:
+  """Explode one bench.py headline JSON into its trajectory rows: the
+  headline, every raced engine contender, and the train A/B engines.
+  Failed runs (``error`` present / no engines) yield no rows — "not
+  measured" must never enter a baseline window as a zero."""
+  if 'error' in doc:
+    return []
+  device = device or str(doc.get('backend', ''))
+  scale = scale or str(doc.get('scale', ''))
+  unit = str(doc.get('unit', ''))
+  rows = []
+  if isinstance(doc.get('value'), (int, float)) and doc['value'] > 0:
+    rows.append({'bench': 'sampler_headline',
+                 'engine': str(doc.get('engine', '')),
+                 'scale': scale, 'device': device,
+                 'value': float(doc['value']), 'unit': unit})
+  for label, rec in (doc.get('engines') or {}).items():
+    if isinstance(rec, dict) and 'edges_per_sec' in rec:
+      rows.append({'bench': 'sampler_engine', 'engine': str(label),
+                   'scale': scale, 'device': device,
+                   'value': float(rec['edges_per_sec']),
+                   'unit': 'edges/s'})
+  tab = doc.get('train_steps_per_sec')
+  if isinstance(tab, dict) and 'error' not in tab:
+    for eng in ('per_batch', 'superstep'):
+      if isinstance(tab.get(eng), (int, float)):
+        rows.append({'bench': 'train_steps_per_sec', 'engine': eng,
+                     'scale': scale, 'device': device,
+                     'value': float(tab[eng]), 'unit': 'steps/s'})
+  return rows
+
+
+def append_bench_json(history_path: str, doc: dict,
+                      device: Optional[str] = None,
+                      scale: Optional[str] = None,
+                      ts: Optional[float] = None) -> List[dict]:
+  out = []
+  for row in rows_from_bench_json(doc, device=device, scale=scale):
+    out.append(append_run(history_path, ts=ts, **row))
+  return out
+
+
+def main(argv=None) -> int:
+  ap = argparse.ArgumentParser(description=__doc__.split('\n')[0])
+  sub = ap.add_subparsers(dest='cmd', required=True)
+  a = sub.add_parser('append', help='append a bench.py JSON to the '
+                                    'trajectory')
+  a.add_argument('--history', required=True)
+  a.add_argument('--bench-json', required=True)
+  a.add_argument('--device', default=None)
+  a.add_argument('--scale', default=None)
+  s = sub.add_parser('show', help='print the trajectory (filtered)')
+  s.add_argument('--history', required=True)
+  s.add_argument('--bench', default=None)
+  s.add_argument('--engine', default=None)
+  args = ap.parse_args(argv)
+  if args.cmd == 'append':
+    with open(args.bench_json) as f:
+      doc = json.load(f)
+    rows = append_bench_json(args.history, doc, device=args.device,
+                             scale=args.scale)
+    print(json.dumps({'appended': len(rows),
+                      'keys': ['|'.join(run_key(r)) for r in rows]}))
+    if not rows and 'error' in doc:
+      print(f"# bench run not measured ({doc['error'][:120]}); "
+            'nothing appended', file=sys.stderr)
+    return 0
+  runs = load_runs(args.history, bench=args.bench, engine=args.engine)
+  for r in runs:
+    print(json.dumps(r, sort_keys=True))
+  return 0
+
+
+if __name__ == '__main__':
+  sys.exit(main())

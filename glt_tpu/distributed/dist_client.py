@@ -77,7 +77,8 @@ def init_client(num_servers: int, num_clients: int, client_rank: int,
         master_addr, server_port(master_port, s), timeout=rpc_timeout,
         retry=retry,
         breaker=CircuitBreaker(failure_threshold=breaker_threshold,
-                               reset_timeout_s=breaker_reset_s),
+                               reset_timeout_s=breaker_reset_s,
+                               name=f'server:{s}'),
         metrics=_metrics)
 
   def probe(rank):
@@ -227,7 +228,12 @@ def export_fabric_trace(path: str,
     try:
       lists.append(keep(collect_obs(s)['events']))
     except Exception as e:  # noqa: BLE001 — harvest is best-effort
+      # a dead endpoint is a counted miss, never an abort: the merged
+      # trace still ships with every reachable peer's spans
       logger.warning('obs harvest from server %d failed: %s', s, e)
+      from ..obs import get_registry
+      get_registry().counter('obs_harvest_misses_total',
+                             server=str(s)).inc()
   import json
   with open(path, 'w') as f:
     json.dump(merge_chrome_traces(*lists), f)

@@ -427,7 +427,7 @@ class RpcClient:
     self._retry = retry or RetryPolicy()
     self._idempotent = IDEMPOTENT_CALLEES | frozenset(idempotent or ())
     self.metrics = metrics
-    self.breaker = breaker or CircuitBreaker()
+    self.breaker = breaker or CircuitBreaker(name=f'{host}:{port}')
     if self.breaker.on_open is None:
       self.breaker.on_open = self._on_breaker_open
     self.retries = 0

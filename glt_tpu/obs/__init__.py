@@ -27,12 +27,30 @@ observations stop (plain registry counters keep counting — exposition
 is independent of the tracing knob); the tier-1 overhead test pins the
 no-op path below 2% of a sampled epoch.
 
+Two further pieces ride the same registry/tracer surfaces:
+
+  * :mod:`perf` — XLA cost accounting (``compiles_total{fn}``,
+    ``xla_flops``/``xla_bytes_accessed``/``xla_peak_bytes`` via the
+    :func:`instrument_compiled` seam) and measured device rooflines
+    (:func:`device_ceilings`, :func:`roofline_report`) so every
+    throughput headline restates as % of a *measured* ceiling.
+  * :mod:`recorder` — the always-on :class:`FlightRecorder` (bounded
+    operational-event ring; resilience trips dump a postmortem JSON
+    into ``GLT_OBS_POSTMORTEM_DIR``) and :class:`SloBurnEvaluator`
+    (``slo_burn{slo=...}`` gauges over the registry histograms).
+
 Knobs (see docs/observability.md for the full table):
 
   GLT_OBS_TRACE=1         enable tracing at import time
   GLT_OBS_TRACE_SAMPLE=p  fraction of spans that device-sync on exit
   GLT_OBS_ANNOTATE=0      disable the device TraceAnnotation bridge
   GLT_OBS_BUFFER=n        span ring-buffer capacity (default 65536)
+  GLT_OBS_XLA_COST=1      opt-in AOT cost publication at test-pinned
+                          compile points (serving warmup)
+  GLT_ROOFLINE_CACHE      measured-ceiling JSON cache path
+  GLT_OBS_POSTMORTEM_DIR  flight-recorder postmortem dump directory
+  GLT_OBS_POSTMORTEM_MIN_S  floor between trip-initiated dumps
+  GLT_OBS_SLO             SLO policies: name:metric:threshold[:obj];...
 """
 from .registry import (
     Counter, Gauge, HistogramMetric, LatencyHistogram, MetricsRegistry,
@@ -42,10 +60,23 @@ from .trace import (
     Span, SpanContext, Tracer, collect_endpoint_obs, get_tracer,
     merge_chrome_traces, save_chrome_trace,
 )
+from .perf import (
+    compile_counts, count_compile, device_ceilings, instrument_compiled,
+    measure_hbm_bandwidth, measure_matmul_flops, roofline_report,
+)
+from .recorder import (
+    FlightRecorder, SloBurnEvaluator, SloPolicy, get_recorder,
+    parse_slo_env, set_recorder,
+)
 
 __all__ = [
     'Counter', 'Gauge', 'HistogramMetric', 'LatencyHistogram',
     'MetricsRegistry', 'get_registry', 'set_registry',
     'Span', 'SpanContext', 'Tracer', 'get_tracer',
     'collect_endpoint_obs', 'merge_chrome_traces', 'save_chrome_trace',
+    'compile_counts', 'count_compile', 'device_ceilings',
+    'instrument_compiled', 'measure_hbm_bandwidth',
+    'measure_matmul_flops', 'roofline_report',
+    'FlightRecorder', 'SloBurnEvaluator', 'SloPolicy', 'get_recorder',
+    'parse_slo_env', 'set_recorder',
 ]

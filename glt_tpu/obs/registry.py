@@ -55,6 +55,21 @@ class LatencyHistogram:
     self.sum += seconds
     self.max = max(self.max, seconds)
 
+  def count_above(self, seconds: float) -> int:
+    """Observations in buckets strictly above the one holding
+    ``seconds`` (bucket-resolution approximation, ~5% edge error like
+    every other read here; the overflow bucket always counts). The SLO
+    burn evaluator's windowed bad-event count derives from deltas of
+    this."""
+    return sum(self._counts[self._bin(seconds) + 1:])
+
+  def fraction_above(self, seconds: float) -> float:
+    """Fraction of all observations above ``seconds`` (0.0 when
+    empty)."""
+    if self.count == 0:
+      return 0.0
+    return self.count_above(seconds) / self.count
+
   def percentile(self, q: float) -> float:
     """q in [0, 100]; returns the upper edge of the bucket holding the
     q-th request (0.0 when empty). ``q=0`` returns the underflow edge
@@ -91,6 +106,14 @@ def _key(name: str, labels: Optional[dict]) -> _Key:
     return (str(name), ())
   return (str(name),
           tuple(sorted((str(k), str(v)) for k, v in labels.items())))
+
+
+def _escape_label_value(v) -> str:
+  """Prometheus text-exposition label-value escaping (format 0.0.4):
+  backslash, double-quote and newline must be escaped or a value like
+  ``say "hi"`` emits malformed exposition text that scrapers reject."""
+  return (str(v).replace('\\', r'\\').replace('"', r'\"')
+          .replace('\n', r'\n'))
 
 
 def _render_key(key: _Key) -> str:
@@ -173,6 +196,22 @@ class HistogramMetric(_Instrument):
   def percentile(self, q: float) -> float:
     with self._lock:
       return self._hist.percentile(q)
+
+  def count_above(self, seconds: float) -> int:
+    with self._lock:
+      return self._hist.count_above(seconds)
+
+  def fraction_above(self, seconds: float) -> float:
+    with self._lock:
+      return self._hist.fraction_above(seconds)
+
+  def count_and_above(self, seconds: float) -> Tuple[int, int]:
+    """(total count, count above threshold) under ONE lock hold — the
+    paired read the SLO burn evaluator windows on (reading them
+    separately tears under concurrent observers and can overstate the
+    bad fraction)."""
+    with self._lock:
+      return self._hist.count, self._hist.count_above(seconds)
 
   @property
   def count(self) -> int:
@@ -306,7 +345,8 @@ class MetricsRegistry:
       pairs = list(items) + list(extra)
       if not pairs:
         return ''
-      return '{' + ','.join(f'{k}="{v}"' for k, v in pairs) + '}'
+      return ('{' + ','.join(
+          f'{k}="{_escape_label_value(v)}"' for k, v in pairs) + '}')
 
     with self._lock:
       lines = []

@@ -261,11 +261,16 @@ class Tracer:
 
   def _record(self, span: Span) -> None:
     with self._lock:
-      if len(self._spans) == self._spans.maxlen:
+      dropping = len(self._spans) == self._spans.maxlen
+      if dropping:
         self.dropped += 1
       self._spans.append(span)
     reg = self._registry if self._registry is not None \
         else get_registry()
+    if dropping:
+      # ``dropped`` alone is a silent attribute nothing scrapes; the
+      # counter makes span loss visible in every registry snapshot
+      reg.inc('obs_spans_dropped_total')
     reg.observe('stage_seconds', span.dur_us / 1e6, stage=span.name)
 
   # -- export ------------------------------------------------------------

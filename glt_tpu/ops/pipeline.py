@@ -94,10 +94,15 @@ def count_engine_fallback(requested: str, resolved: str,
       'GLT_HOP_ENGINE=%s resolved to %r (%s)', requested, resolved,
       reason)
   try:
-    from ..obs import get_registry
+    from ..obs import get_recorder, get_registry
     get_registry().counter('hop_engine_fallbacks_total',
                            requested=requested, resolved=resolved,
                            reason=reason).inc()
+    # breadcrumb for postmortems: a fleet that quietly lost its fused
+    # kernels shows up in the flight-recorder ring next to whatever
+    # tripped later
+    get_recorder().record('hop_engine_fallback', requested=requested,
+                          resolved=resolved, reason=reason)
   except Exception:  # metrics must never break sampling
     pass
 
@@ -244,6 +249,12 @@ def multihop_sample(one_hop: OneHopFn,
   edge_mask still see one well-defined value per engine
   (tests/test_sorted_inducer.py pins this).
   """
+  # trace-time tick on the shared hop loop: every enclosing program
+  # that (re)traces it shows up under one process-wide label — the
+  # pipeline-level row of compiles_total{fn=...} (jit-boundary callers
+  # carry their own finer labels)
+  from ..obs.perf import count_compile
+  count_compile('ops.multihop_sample')
   if fused_plan is not None:
     out = _multihop_sample_fused(fused_plan, seeds, n_valid, fanouts,
                                  key, with_edge=with_edge)
@@ -566,6 +577,8 @@ def multihop_sample_hetero(one_hops, trav, num_neighbors, num_hops,
   row(parent)/col(child) label buffers in traversal orientation, batch
   and seed_labels dicts, per-hop counts. Tables come back reset.
   """
+  from ..obs.perf import count_compile
+  count_compile('ops.multihop_sample_hetero')  # trace-time only
   from .unique import dense_assign, dense_init, dense_reset
   if dedup_engine() == 'sort':
     result = _multihop_sample_hetero_sorted(

@@ -232,6 +232,8 @@ class SPMDSageTrainStep:
       # compile request body — hundreds of MB of topology in the
       # payload (observed HTTP 413 at products scale)
       self.step_traces += 1  # trace-time side effect only
+      from ..obs.perf import count_compile
+      count_compile('train.step')
       return fn(params, opt_state, tables, scratches, seeds, n_valid,
                 keys, feat_array, labels, indptr, indices, *cold)
 
@@ -278,6 +280,8 @@ class SPMDSageTrainStep:
              n_valid_stack, keys, feat_array, labels, indptr, indices,
              *cold):
       self.superstep_traces += 1  # trace-time side effect only
+      from ..obs.perf import count_compile
+      count_compile('train.superstep')
       return fn(params, opt_state, tables, scratches, seeds_stack,
                 n_valid_stack, keys, feat_array, labels, indptr,
                 indices, *cold)
@@ -381,6 +385,8 @@ class SPMDSageTrainStep:
     def sample(tables, scratches, seeds_stack, n_valid_stack, keys,
                indptr, indices):
       self.superstep_traces += 1  # trace-time side effect only
+      from ..obs.perf import count_compile
+      count_compile('train.sample_superstep')
       return fn(tables, scratches, seeds_stack, n_valid_stack, keys,
                 indptr, indices)
 
@@ -435,6 +441,8 @@ class SPMDSageTrainStep:
     def consume(params, opt_state, outs, cold_x, n_valid_stack,
                 feat_array, labels):
       self.superstep_traces += 1  # trace-time side effect only
+      from ..obs.perf import count_compile
+      count_compile('train.consume_superstep')
       return fn(params, opt_state, outs, cold_x, n_valid_stack,
                 feat_array, labels)
 

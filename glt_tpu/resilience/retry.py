@@ -83,16 +83,22 @@ class CircuitBreaker:
 
   Thread-safe; all transitions happen under one lock. ``on_open`` is
   called (outside the lock) every CLOSED/HALF_OPEN -> OPEN transition —
-  the metrics hook.
+  the metrics hook. Every open also lands on the process flight
+  recorder (``glt_tpu.obs.get_recorder().trip('breaker_open')``): a
+  breaker opening is exactly the moment a postmortem wants the recent
+  span/counter context captured. ``name`` labels the peer in that
+  event (optional, purely observational).
   """
 
   def __init__(self, failure_threshold: int = 5,
                reset_timeout_s: float = 5.0,
-               on_open: Optional[Callable[[], None]] = None):
+               on_open: Optional[Callable[[], None]] = None,
+               name: str = ''):
     assert failure_threshold >= 1
     self.failure_threshold = int(failure_threshold)
     self.reset_timeout_s = float(reset_timeout_s)
     self.on_open = on_open
+    self.name = str(name)
     self._lock = threading.Lock()
     self._state = CLOSED
     self._consecutive_failures = 0
@@ -145,9 +151,21 @@ class CircuitBreaker:
         self._opened_at = time.monotonic()
         self.opens += 1
         fire = True
-    if fire and self.on_open is not None:
-      try:
-        self.on_open()
+      # snapshot the trip payload under the lock: a concurrent
+      # record_success resetting the streak before the trip below
+      # would otherwise record consecutive_failures=0 for an OPEN
+      failures, opens = self._consecutive_failures, self.opens
+    if fire:
+      if self.on_open is not None:
+        try:
+          self.on_open()
+        except Exception:
+          pass
+      try:  # postmortem hook — must never break the failure path
+        from ..obs.recorder import get_recorder
+        get_recorder().trip(
+            'breaker_open', breaker=self.name,
+            consecutive_failures=failures, opens=opens)
       except Exception:
         pass
 

@@ -366,6 +366,11 @@ class NeighborSampler(BaseSampler):
     fused_plan = self._fused_plan(batch_size)
 
     def fn(seeds, n_valid, key, table, scratch):
+      # trace-time side effect: one compiles_total{fn=...} tick per
+      # compiled seed-shape program (the registry counterpart of
+      # num_compiled_fns — executions never bump it)
+      from ..obs.perf import count_compile
+      count_compile('sampler.homo')
       return multihop_sample(one_hop, seeds, n_valid, self.num_neighbors,
                              key, table, scratch,
                              with_edge=self.with_edge,
@@ -463,6 +468,8 @@ class NeighborSampler(BaseSampler):
         for e in self.edge_types}
 
     def fn(seeds, n_valid, key, tables):
+      from ..obs.perf import count_compile
+      count_compile('sampler.hetero')  # trace-time only, like homo
       return multihop_sample_hetero(
           one_hops, trav, self.num_neighbors, self.num_hops, caps,
           budgets, seeds, n_valid, key, tables,

@@ -320,6 +320,15 @@ class MicroBatcher:
             '(wedged forward / dead device); failing pending requests')
         for r in victims:
           _fail_future(r.future, err)
+        try:  # postmortem flight-recorder dump (obs layer) — AFTER the
+          # victims are failed: clients already past their stall budget
+          # must not also wait out a registry snapshot + disk write
+          from ..obs.recorder import get_recorder
+          get_recorder().trip(
+              'engine_stall', stall_timeout_s=self.stall_timeout,
+              victims=len(victims), error=str(err))
+        except Exception:
+          pass
 
   def _dispatch(self, batch: List[_Request]) -> None:
     try:

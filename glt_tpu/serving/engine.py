@@ -110,6 +110,8 @@ class InferenceEngine:
       # trace-time side effect: executions never touch this counter, so
       # steady-state assertions can demand it stays flat
       self._trace_counts[bucket] = self._trace_counts.get(bucket, 0) + 1
+      from ..obs.perf import count_compile
+      count_compile('serve.forward')  # process-wide compiles_total{fn}
       return self._apply_fn(params, batch)
     return jax.jit(fwd)
 
@@ -118,13 +120,29 @@ class InferenceEngine:
       self._fwd[bucket] = self._make_forward(bucket)
     return self._fwd[bucket]
 
-  def warmup(self) -> dict:
+  def warmup(self, publish_costs: Optional[bool] = None) -> dict:
     """Compile every bucket's sample+gather+forward pipeline once with
     dummy seeds. Serving before warmup works but pays compilation on
-    first use of each bucket."""
+    first use of each bucket.
+
+    ``publish_costs`` (default: the ``GLT_OBS_XLA_COST`` knob, off)
+    additionally AOT-lowers each bucket's forward and publishes its
+    XLA cost analysis as ``xla_flops{fn="serve.forward[b<bucket>]"}``
+    etc. — NOTE this is one extra trace per bucket (the
+    ``forward_traces`` counters each read 2 after warmup instead of
+    1), which is why it is opt-in rather than ambient."""
+    if publish_costs is None:
+      from ..obs.perf import xla_cost_enabled
+      publish_costs = xla_cost_enabled()
     with self._lock:
       for b in self.buckets:
         self._run_bucket(np.zeros(b, np.int64), b, b)
+      if publish_costs:
+        from ..obs.perf import instrument_compiled
+        for b in self.buckets:
+          batch = self.make_batch(np.zeros(b, np.int64), b, b)
+          instrument_compiled(f'serve.forward[b{b}]', self._forward(b),
+                              self.params, batch)
       self._warmed = True
       # warmup never inserts into the cache (only infer does), so only
       # the stats need resetting — a caller-supplied pre-populated

@@ -44,6 +44,13 @@ class ServingServer:
       requests from the versioned EmbeddingCache (zero-fill for
       misses, stale_serves counted) instead of failing fast — the
       opt-in availability-over-freshness tier.
+    slos: latency SLO policies (:class:`glt_tpu.obs.SloPolicy` list)
+      evaluated on every ``stats()`` pull — each publishes a
+      ``slo_burn{slo=...}`` gauge (windowed error-budget burn; the
+      per-shard autoscaling/paging signal) and lands in the stats
+      payload. None reads the ``GLT_OBS_SLO`` knob; policies without
+      an explicit metric label default onto THIS server's
+      ``serving_latency_seconds`` series.
   """
 
   def __init__(self, engine: InferenceEngine, host: str = '127.0.0.1',
@@ -53,7 +60,8 @@ class ServingServer:
                warmup: bool = True,
                stall_timeout_ms: Optional[float] = None,
                stale_serve: bool = False,
-               registry=None, metrics_name: str = ''):
+               registry=None, metrics_name: str = '',
+               slos=None):
     self.engine = engine
     self.stale_serve = bool(stale_serve)
     if warmup:
@@ -73,6 +81,32 @@ class ServingServer:
         request_timeout_ms=request_timeout_ms, metrics=self.metrics,
         stall_timeout_ms=stall_timeout_ms)
     self._request_timeout_ms = request_timeout_ms
+    # SLO burn: evaluated lazily on each stats() pull (the scrape/
+    # health cadence IS the evaluation window) over this server's own
+    # metrics registry, so per-shard burn gauges come for free when a
+    # shared registry + metrics_name labels the fleet
+    import dataclasses as _dc
+    from ..obs.recorder import SloBurnEvaluator, parse_slo_env
+    if slos is None:
+      # a malformed GLT_OBS_SLO typo must degrade to no-SLO, not take
+      # down serving (the env-knob bug class: GLT_OBS_BUFFER et al.)
+      try:
+        slos = parse_slo_env()
+      except ValueError as e:
+        import logging
+        logging.getLogger(__name__).warning(
+            'ignoring malformed GLT_OBS_SLO: %s', e)
+        slos = []
+    # policies are COPIED before defaulting labels: a slos list shared
+    # across servers must not have server A's view label stamped onto
+    # the objects server B then evaluates
+    policies = [
+        _dc.replace(p, labels=(dict(p.labels) if p.labels
+                               else dict(self.metrics._labels)))
+        for p in slos]
+    self.slo = SloBurnEvaluator(policies,
+                                registry=self.metrics.registry) \
+        if policies else None
     # register BEFORE start(): a pre-registered server fails unknown
     # names fast instead of stalling the connection (rpc.RpcServer)
     self.rpc = RpcServer(host=host, port=port, auto_start=False)
@@ -129,6 +163,9 @@ class ServingServer:
     out['engine'] = self.engine.compile_stats()
     out['stalled'] = self.batcher.stalled
     out['stale_serve_enabled'] = self.stale_serve
+    if self.slo is not None:
+      out['slo_burn'] = {k: round(v, 4)
+                         for k, v in self.slo.evaluate().items()}
     return out
 
   def invalidate(self, ids=None, version=None) -> int:

@@ -325,6 +325,15 @@ class StreamIngestor:
             # then exit — a crash-looping applier must not keep
             # draining/restaging the same poisoned cut forever
             self._bg_error = e
+            try:  # postmortem: the applier dying IS the incident
+              from ..obs.recorder import get_recorder
+              get_recorder().trip(
+                  'ingestor_crash', error=repr(e),
+                  tick_failures=self._tick_failures,
+                  tick_errors_total=self.tick_errors_total,
+                  restart_policy=self.restart_policy)
+            except Exception:
+              pass
             return
         else:
           self._tick_failures = 0

@@ -238,6 +238,8 @@ class StreamSampler(BaseSampler):
 
     def fn(arrays, seeds, n_valid, key, table, scratch):
       self.trace_count += 1  # trace-time only; executions never bump
+      from ..obs.perf import count_compile
+      count_compile('stream.sample')  # compiles_total{fn=...}
       hop = {'i': 0}
 
       def one_hop(ids, _eff_fanout, sub, mask):

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Noise-aware bench regression gate.
+
+Compares a fresh ``bench.py`` headline JSON against the recorded
+trajectory (``benchmarks/history.py`` JSONL) and **exits nonzero on
+regression** — the CI gate that turns the bench from a one-off
+snapshot into a ratchet.
+
+Per series (exact (bench, engine, scale, device) key match):
+
+  * baseline = median of the last ``--median-of`` recorded runs
+    (median: one noisy runner in the window must not move the bar);
+  * a series needs ``--min-runs`` history rows before it gates at all
+    (a single prior run is itself noise);
+  * regression = relative drop vs baseline >= ``--threshold`` — all
+    series here are throughput (higher is better);
+  * a bench run that failed to measure (``error`` field) gates
+    nothing: "not measured" is not "measured as 0" (bench.py's own
+    contract), and the append step skips it too.
+
+Usage (CI order: gate against the PAST, then append the present)::
+
+    python scripts/bench_compare.py \
+        --history bench_history.jsonl --current bench_smoke.json \
+        [--threshold 0.30] [--median-of 5] [--min-runs 2]
+    python benchmarks/history.py append \
+        --history bench_history.jsonl --bench-json bench_smoke.json
+
+Prints one JSON report to stdout; exit 0 = no regression (or nothing
+gateable yet), 1 = regression, 2 = bad invocation.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, '..', 'benchmarks'))
+
+from history import baseline, load_runs, rows_from_bench_json  # noqa: E402
+
+
+def compare(history_path: str, current: dict, threshold: float = 0.30,
+            median_of: int = 5, min_runs: int = 2) -> dict:
+  """Pure comparison (no exit): returns the report dict with
+  ``regressions`` / ``ok`` / ``skipped`` series lists."""
+  report = {'threshold': threshold, 'median_of': median_of,
+            'min_runs': min_runs, 'regressions': [], 'ok': [],
+            'skipped': []}
+  if 'error' in current:
+    report['skipped'].append(
+        {'reason': 'current run not measured',
+         'error': str(current['error'])[:200]})
+    return report
+  rows = rows_from_bench_json(current)
+  if not rows:
+    report['skipped'].append({'reason': 'no series in current run'})
+    return report
+  for row in rows:
+    runs = load_runs(history_path, bench=row['bench'],
+                     engine=row['engine'], scale=row['scale'],
+                     device=row['device'])
+    key = '|'.join((row['bench'], row['engine'], row['scale'],
+                    row['device']))
+    if len(runs) < min_runs:
+      report['skipped'].append(
+          {'series': key, 'reason': f'only {len(runs)} recorded '
+                                    f'run(s) (< {min_runs})'})
+      continue
+    base = baseline(runs, median_of=median_of)
+    entry = {
+        'series': key,
+        'value': row['value'],
+        'baseline': round(base, 3),
+        'ratio': round(row['value'] / base, 4) if base else None,
+        'window': min(len(runs), median_of),
+    }
+    drop = (1.0 - row['value'] / base) if base else 0.0
+    if base and drop >= threshold:
+      entry['drop_pct'] = round(100.0 * drop, 1)
+      report['regressions'].append(entry)
+    else:
+      report['ok'].append(entry)
+  return report
+
+
+def main(argv=None) -> int:
+  ap = argparse.ArgumentParser(description=__doc__.split('\n')[1])
+  ap.add_argument('--history', required=True,
+                  help='trajectory JSONL (benchmarks/history.py)')
+  ap.add_argument('--current', required=True,
+                  help='fresh bench.py headline JSON')
+  ap.add_argument('--threshold', type=float, default=0.30,
+                  help='relative drop that fails the gate '
+                       '(default 0.30 = 30%%)')
+  ap.add_argument('--median-of', type=int, default=5,
+                  help='baseline = median of the last N runs')
+  ap.add_argument('--min-runs', type=int, default=2,
+                  help='history rows a series needs before gating')
+  args = ap.parse_args(argv)
+  try:
+    with open(args.current) as f:
+      current = json.load(f)
+  except (OSError, ValueError) as e:
+    print(f'bench_compare: cannot read {args.current}: {e}',
+          file=sys.stderr)
+    return 2
+  report = compare(args.history, current, threshold=args.threshold,
+                   median_of=args.median_of, min_runs=args.min_runs)
+  print(json.dumps(report, indent=2))
+  if report['regressions']:
+    for r in report['regressions']:
+      print(f"bench_compare: REGRESSION {r['series']}: "
+            f"{r['value']:.1f} vs baseline {r['baseline']:.1f} "
+            f"(-{r['drop_pct']}%)", file=sys.stderr)
+    return 1
+  return 0
+
+
+if __name__ == '__main__':
+  sys.exit(main())
