@@ -121,11 +121,19 @@ def rows_from_bench_json(doc: dict, device: Optional[str] = None,
                  'scale': scale, 'device': device,
                  'value': float(doc['value']), 'unit': unit})
   for label, rec in (doc.get('engines') or {}).items():
-    if isinstance(rec, dict) and 'edges_per_sec' in rec:
-      rows.append({'bench': 'sampler_engine', 'engine': str(label),
-                   'scale': scale, 'device': device,
-                   'value': float(rec['edges_per_sec']),
-                   'unit': 'edges/s'})
+    if not (isinstance(rec, dict) and 'edges_per_sec' in rec):
+      continue
+    if str(label).endswith('_smoke'):
+      # fused-walk duel entries: 3-iteration toy-protocol timings whose
+      # evidence is the launch/byte cells, not edges/s — a trajectory
+      # series over them would only feed runner noise into the
+      # regression gate (threshold-sized dips on shared runners)
+      continue
+    rows.append({'bench': 'sampler_engine', 'engine': str(label),
+                 'scale': str(rec.get('scale', scale)),
+                 'device': device,
+                 'value': float(rec['edges_per_sec']),
+                 'unit': 'edges/s'})
   tab = doc.get('train_steps_per_sec')
   if isinstance(tab, dict) and 'error' not in tab:
     for eng in ('per_batch', 'superstep'):

@@ -180,6 +180,27 @@ class Graph:
         out[f] = have[1]
     return out
 
+  def indptr_pad(self):
+    """The CSR offsets with ONE trailing ``num_edges`` sentinel
+    (``[N + 2]`` int32) — the cross-hop walk kernel's row-window source
+    (ops/pallas_kernels.py::sample_walk_dedup): a clamped 2-wide read
+    at row ``min(id, N)`` then reproduces the element path's
+    per-element ``take(..., mode='clip')`` start/degree semantics for
+    masked frontier rows. Built eagerly once and cached (the sampler
+    builds one FusedHopPlan per compiled batch shape — multi-bucket
+    serving must not materialize one padded copy per bucket)."""
+    self.lazy_init()
+    with self._window_lock:
+      have = self._window_cache.get('indptr_pad')
+      if have is None:
+        import jax.numpy as jnp
+        with jax.ensure_compile_time_eval():
+          have = jnp.concatenate(
+              [jnp.asarray(self.indptr, jnp.int32),
+               jnp.full((1,), int(self.num_edges), jnp.int32)])
+        self._window_cache['indptr_pad'] = have
+      return have
+
   def hub_count(self, width: int) -> int:
     """Number of rows with degree > ``width`` — the exact hub capacity
     ``H`` of the windowed sampling paths (``sample_neighbors``'s

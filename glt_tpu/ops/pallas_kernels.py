@@ -42,6 +42,30 @@ import os
 import jax
 import jax.numpy as jnp
 
+#: trace-time kernel-launch accounting: every pallas_call built by this
+#: module bumps the counter ONCE PER TRACE (executions never touch it).
+#: ``kernel_launch_count()`` deltas around an AOT lower therefore equal
+#: the number of kernel entries in the lowered program — the
+#: interpret-mode fallback for bench.py's ``kernel_launches_per_dispatch``
+#: (on TPU the lowered HLO's custom-call count is the ground truth; in
+#: interpret mode kernels inline into plain HLO and leave no custom
+#: call to count).
+_LAUNCHES = {'n': 0}
+
+
+def kernel_launch_count() -> int:
+  """Cumulative pallas_call constructions traced by this process.
+  CAVEAT: the bump lives in the jitted wrappers' Python bodies, so an
+  inner jit-cache hit (same kernel, same avals, traced earlier) does
+  NOT re-count — take deltas against a cold cache (jax.clear_caches())
+  or around the FIRST lower of a given shape signature (what bench.py
+  and instrument_compiled do)."""
+  return _LAUNCHES['n']
+
+
+def _count_launch() -> None:
+  _LAUNCHES['n'] += 1
+
 
 def pallas_available() -> bool:
   try:
@@ -50,6 +74,59 @@ def pallas_available() -> bool:
     return True
   except ImportError:
     return False
+
+
+#: memoized auto-probe verdict (None = not yet probed)
+_AUTO_PROBE = {'ok': None}
+
+
+def auto_probe_ok() -> bool:
+  """One-time compile probe gating the backend-aware ``auto`` hop
+  engine (ops/pipeline.py::hop_engine): the fused kernels have never
+  run on real TPU hardware (the dev tunnel has been down since r2), so
+  ``auto`` must not put an unproven Mosaic program on every sampler in
+  the fleet on the strength of interpret-mode tests alone. This
+  compiles the per-hop AND cross-hop kernels at toy shapes on the
+  actual backend once per process; any failure demotes ``auto`` to the
+  XLA ``element`` engine with a counted fallback instead of breaking
+  sampling. Explicit ``GLT_HOP_ENGINE=pallas_fused`` trusts the
+  operator and skips the probe."""
+  if _AUTO_PROBE['ok'] is not None:
+    return _AUTO_PROBE['ok']
+  try:
+    interp = interpret_default()
+    iw = jnp.concatenate([jnp.arange(64, dtype=jnp.int32),
+                          jnp.full((8,), -1, jnp.int32)])
+    ipad = jnp.concatenate(
+        [jnp.arange(0, 66, 8, dtype=jnp.int32)[:9],
+         jnp.full((1,), 64, jnp.int32)])
+    starts = jnp.zeros((8,), jnp.int32)
+    offsets = jnp.zeros((8, 2), jnp.int32)
+    valid = jnp.ones((8, 2), jnp.int32)
+    hub_rows = jnp.full((1,), -1, jnp.int32)
+    hub_slots = jnp.zeros((1, 2), jnp.int32)
+    tab_ids, tab_labs = make_dedup_table(8 * TABLE_LANES)
+    count = jnp.zeros((), jnp.int32)
+    sample_hop_dedup.lower(
+        iw, None, starts, offsets, valid, hub_rows, hub_slots,
+        tab_ids, tab_labs, count, width=8,
+        interpret=interp).compile()
+    u = (jnp.zeros((8, 2), jnp.float32),)
+    sample_walk_dedup.lower(
+        iw, None, ipad, jnp.zeros((8,), jnp.int32),
+        jnp.ones((8,), jnp.int32), jnp.zeros((8,), jnp.int32),
+        jnp.zeros((8,), jnp.int32), jnp.zeros((), jnp.int32), u,
+        fanouts=(2,), width=8, num_nodes=8, num_edges=64,
+        table_slots=8 * TABLE_LANES, batch_size=8,
+        interpret=interp).compile()
+    _AUTO_PROBE['ok'] = True
+  except Exception as e:  # Mosaic/lowering failure: demote, don't break
+    import logging
+    logging.getLogger(__name__).warning(
+        'pallas auto-probe failed (%s); GLT_HOP_ENGINE=auto stays on '
+        'the XLA element engine for this process', e)
+    _AUTO_PROBE['ok'] = False
+  return _AUTO_PROBE['ok']
 
 
 def use_pallas_default() -> bool:
@@ -144,6 +221,7 @@ def gather_windows(arr: jax.Array, starts: jax.Array, width: int,
       out_specs=pl.BlockSpec((block, width), lambda i, idx: (i, 0)),
       scratch_shapes=[pltpu.SemaphoreType.DMA((block,))],
   )
+  _count_launch()
   out = pl.pallas_call(
       kernel,
       grid_spec=grid_spec,
@@ -190,6 +268,7 @@ def gather_rows(table: jax.Array, rows: jax.Array,
       ],
       out_specs=pl.BlockSpec((1, 1, d), lambda i, idx: (i, 0, 0)),
   )
+  _count_launch()
   out = pl.pallas_call(
       kernel,
       grid_spec=grid_spec,
@@ -390,6 +469,7 @@ def sample_hop(arr_win: jax.Array,
           + [pltpu.SemaphoreType.DMA((2, block)) for _ in arrs]
           + [pltpu.SemaphoreType.DMA((block, fanout)) for _ in arrs]),
   )
+  _count_launch()
   outs = pl.pallas_call(
       kernel,
       grid_spec=grid_spec,
@@ -557,6 +637,7 @@ def dedup_table_insert(tab_ids: jax.Array, tab_labs: jax.Array,
                       pltpu.VMEM(tab_ids.shape, jnp.int32),
                       pltpu.SemaphoreType.DMA((2,))],
   )
+  _count_launch()
   return pl.pallas_call(
       kernel,
       grid_spec=grid_spec,
@@ -733,6 +814,7 @@ def sample_hop_dedup(arr_win: jax.Array,
              pltpu.VMEM(tshape, jnp.int32),
              pltpu.SemaphoreType.DMA((2,))]),
   )
+  _count_launch()
   outs = pl.pallas_call(
       kernel,
       grid_spec=grid_spec,
@@ -751,3 +833,470 @@ def sample_hop_dedup(arr_win: jax.Array,
   new_head = outs[n_a + 1][:s]
   return (picks, eid_picks, prov_labels, new_head,
           outs[n_a + 2], outs[n_a + 3])
+
+
+# ---------------------------------------------------------------------------
+# Cross-hop fused walk (ISSUE 13 tentpole): the WHOLE multi-hop walk as
+# one kernel invocation.
+#
+# The per-hop family above still pays, at every hop boundary: a kernel
+# teardown/launch, a full HBM write-back + reload of both [n_buckets,
+# 128] table planes, and a fresh read of the padded edge array operand.
+# Here the grid covers every hop's frontier blocks back to back (hop
+# boundaries are grid phases, statically unrolled), and the dedup table
+# lives in VMEM *scratch* for the whole walk — it never exists in HBM
+# at all: step 0 memsets it and inserts the exact-dedup'd seed hop, and
+# each phase probes/inserts its picks against the same resident planes.
+#
+# What had to move in-kernel for the walk to stay on-chip: hop h+1's
+# frontier is hop h's picks, so the kernel (a) writes each hop's masked
+# picks to a small HBM staging buffer (the only cross-hop HBM traffic
+# left — [S_h, K_h] int32 per hop vs two table planes + the edge-array
+# operand per hop before), (b) DMAs the next block's frontier ids +
+# their indptr pairs while the current block computes, and (c) derives
+# the Floyd/replace offsets from precomputed per-hop uniform draws (the
+# draws are data-independent, so XLA generates them up front from the
+# same jax.random stream — bit-identical offsets by construction). Hub
+# rows are fixed up per-row (degree > W => exact per-element reads), so
+# the walk needs no hub list and no hub cap at all.
+#
+# One DMA pipeline serves every hop: the double-buffered window slots
+# prefetch block i+1's CSR windows (frontier -> indptr -> window chain
+# resolved ahead of the probe section) across hop-interior steps; the
+# pipeline only hiccups for one block at each hop boundary, where the
+# next frontier literally does not exist until the current step's picks
+# are written.
+# ---------------------------------------------------------------------------
+
+
+def walk_geometry(batch_size: int, fanouts, block: int = 8):
+  """Static hop-phase geometry of the cross-hop walk: per hop a dict of
+  frontier rows (``s``), block-padded rows (``s_pad``), first grid step
+  (``step0``), step count (``nb``) and fanout (``k``). Returns
+  ``(hops, total_steps)``."""
+  hops = []
+  s = max(int(batch_size), 1)
+  step = 0
+  for k in fanouts:
+    k = int(k)
+    assert k > 0, 'the cross-hop walk serves uniform positive fanouts'
+    nb = -(-s // block)
+    hops.append(dict(s=s, s_pad=nb * block, step0=step, nb=nb, k=k))
+    step += nb
+    s = s * k
+  return hops, step
+
+
+@functools.partial(jax.jit, static_argnames=(
+    'fanouts', 'width', 'num_nodes', 'num_edges', 'table_slots',
+    'batch_size', 'replace', 'block', 'interpret'))
+def sample_walk_dedup(arr_win: jax.Array,
+                      eids_win: 'Optional[jax.Array]',
+                      indptr_pad: jax.Array,
+                      seed_ids: jax.Array,
+                      seed_ok: jax.Array,
+                      seed_tab_ids: jax.Array,
+                      seed_tab_labs: jax.Array,
+                      base_count: jax.Array,
+                      u_hops,
+                      *,
+                      fanouts,
+                      width: int,
+                      num_nodes: int,
+                      num_edges: int,
+                      table_slots: int,
+                      batch_size: int,
+                      replace: bool = False,
+                      block: int = 8,
+                      interpret: bool = False):
+  """The cross-hop walk megakernel: every uniform hop's window DMA +
+  offset pick + hub fix-up + dedup-table assign in ONE kernel, the
+  table resident in VMEM scratch for the whole walk.
+
+  Args:
+    arr_win / eids_win: W-padded edge array(s), as in ``sample_hop``.
+    indptr_pad: [N + 2] int32 — the CSR indptr with ONE trailing
+      ``num_edges`` sentinel, so the kernel's 2-wide row reads at a
+      clamped address reproduce the element path's per-element
+      ``take(..., mode='clip')`` start/degree semantics exactly
+      (an invalid frontier id — INT32_MAX — clamps to row N and reads
+      ``[E, E]``: degree 0, window over the sentinel padding, the same
+      values the XLA engines read for masked rows).
+    seed_ids: [S1_pad] int32 — hop 1's frontier in the sorted-seed
+      order (``sorted_hop_dedup``'s ``ids3``), RAW ids: duplicate seeds
+      keep their real id (they read real windows, exactly like the
+      ``sort+fused`` reference) and validity rides ``seed_ok``.
+    seed_ok: [S1_pad] int32 — hop 1 frontier validity (``new_head3``).
+    seed_tab_ids / seed_tab_labs: [B_pad] int32 — the exact-dedup'd
+      seed uniques (+ labels) inserted into the fresh table at step 0;
+      -1 ids are skipped. Scalar-prefetched (the insert loop indexes
+      them dynamically).
+    base_count: [1] int32 — labels assigned before hop 1 (seed count);
+      fresh ids get provisional labels ``base + r`` in global
+      first-occurrence order, ``r`` carried in SMEM across all hops.
+    u_hops: tuple of per-hop uniform draws, hop h shaped
+      [S_h_pad, K_h] float32 with ``u[row, j] = uniform_h[j, row]``
+      (the element path's ``_floyd_offsets`` orientation transposed;
+      for ``replace`` the natural [S, K] draw). Data-independent, so
+      the caller draws them up front from the unchanged key sequence.
+    fanouts: static positive per-hop fanouts.
+    table_slots: dedup-table capacity (``fused_table_slots``); the two
+      VMEM-resident planes cost ``2 * table_slots * 4`` bytes of
+      scratch for the whole kernel.
+
+  Returns ``(picks, eid_picks|None, prov, new_head)`` — tuples with one
+  [S_h_pad, K_h] entry per hop; ``prov`` labels are provisional (global
+  first-occurrence order), converted to the ``sorted_hop_dedup_fused``
+  value-order contract by the caller
+  (ops/pipeline.py::_multihop_sample_walk) with one narrow sort per
+  hop. The masked-lane values of ``picks``/``eid_picks`` match the
+  window-read reference bit-for-bit (same physical slots).
+  """
+  from jax.experimental import pallas as pl
+  from jax.experimental.pallas import tpu as pltpu
+
+  big = jnp.iinfo(jnp.int32).max
+  n_hops = len(fanouts)
+  hops, total_steps = walk_geometry(batch_size, fanouts, block)
+  with_eids = eids_win is not None
+  arrs = (arr_win, eids_win) if with_eids else (arr_win,)
+  n_a = len(arrs)
+  assert table_slots % TABLE_LANES == 0
+  n_buckets = table_slots // TABLE_LANES
+  assert n_buckets & (n_buckets - 1) == 0, 'bucket count must be pow2'
+  tshape = (n_buckets, TABLE_LANES)
+  assert seed_ids.shape[0] == hops[0]['s_pad']
+  assert len(u_hops) == n_hops
+  for h, u in zip(hops, u_hops):
+    assert u.shape == (h['s_pad'], h['k']), (u.shape, h)
+  b_pad = seed_tab_ids.shape[0]
+  k_max = max(f for f in fanouts)
+
+  seed_tab_ids = seed_tab_ids.astype(jnp.int32)
+  seed_tab_labs = seed_tab_labs.astype(jnp.int32)
+  base_count = base_count.astype(jnp.int32).reshape((1,))
+  seed_ids = seed_ids.astype(jnp.int32)
+  seed_ok = seed_ok.astype(jnp.int32)
+  indptr_pad = indptr_pad.astype(jnp.int32)
+
+  def kernel(stab_ids_ref, stab_labs_ref, base_ref, *rest):
+    u_refs = rest[:n_hops]
+    ip_ref, sid_ref, sok_ref = rest[n_hops:n_hops + 3]
+    src_refs = rest[n_hops + 3:n_hops + 3 + n_a]
+    pos = n_hops + 3 + n_a
+    picks_refs = rest[pos:pos + n_hops]; pos += n_hops
+    if with_eids:
+      eidp_refs = rest[pos:pos + n_hops]; pos += n_hops
+    prov_refs = rest[pos:pos + n_hops]; pos += n_hops
+    newh_refs = rest[pos:pos + n_hops]; pos += n_hops
+    fp_refs = rest[pos:pos + max(n_hops - 1, 1)]
+    pos += max(n_hops - 1, 1)
+    scr = rest[pos:]
+    vf, vok, vip = scr[0], scr[1], scr[2]
+    win_bufs = scr[3:3 + n_a]
+    hub_bufs = scr[3 + n_a:3 + 2 * n_a]
+    fscrs = scr[3 + 2 * n_a:3 + 2 * n_a + max(n_hops - 1, 1)]
+    spos = 3 + 2 * n_a + max(n_hops - 1, 1)
+    tids, tlabs, r_ref = scr[spos], scr[spos + 1], scr[spos + 2]
+    fsem, oksem, ipsem = scr[spos + 3], scr[spos + 4], scr[spos + 5]
+    wsems = scr[spos + 6:spos + 6 + n_a]
+    hubsems = scr[spos + 6 + n_a:spos + 6 + 2 * n_a]
+    fpsem = scr[spos + 6 + 2 * n_a]
+
+    i = pl.program_id(0)
+    cur = jax.lax.rem(i, 2)
+    nxt = jax.lax.rem(i + 1, 2)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, TABLE_LANES), 1)
+
+    # step 0: fresh table planes (memset, never read from HBM) + the
+    # exact-dedup'd seed insert — the walk's phase 0, folded into the
+    # first sampling step so no separate launch exists even for seeding
+    @pl.when(i == 0)
+    def _():
+      tids[...] = jnp.full(tshape, -1, jnp.int32)
+      tlabs[...] = jnp.full(tshape, -1, jnp.int32)
+
+      def body(t, _):
+        x = stab_ids_ref[t]
+        _probe_insert(tids, tlabs, x, x >= 0, stab_labs_ref[t],
+                      n_buckets, lane)
+        return 0
+
+      jax.lax.fori_loop(0, b_pad, body, 0)
+      r_ref[0] = 0
+
+    # -- DMA chain helpers (slot-parity double buffered) ----------------
+    def start_frontier(hop, b, slot):
+      for j in range(block):
+        if hop == 0:
+          pltpu.make_async_copy(sid_ref.at[pl.ds(b * block + j, 1)],
+                                vf.at[slot, j], fsem.at[slot, j]).start()
+          pltpu.make_async_copy(sok_ref.at[pl.ds(b * block + j, 1)],
+                                vok.at[slot, j],
+                                oksem.at[slot, j]).start()
+        else:
+          prev = hops[hop - 1]
+          r = b * block + j
+          q = jnp.minimum(r // prev['k'], prev['s_pad'] - 1)
+          l = jax.lax.rem(r, prev['k'])
+          pltpu.make_async_copy(fp_refs[hop - 1].at[q, pl.ds(l, 1)],
+                                vf.at[slot, j], fsem.at[slot, j]).start()
+
+    def wait_frontier(hop, slot):
+      for j in range(block):
+        pltpu.make_async_copy(vf.at[slot, j], vf.at[slot, j],
+                              fsem.at[slot, j]).wait()
+        if hop == 0:
+          pltpu.make_async_copy(vok.at[slot, j], vok.at[slot, j],
+                                oksem.at[slot, j]).wait()
+
+    def start_ip(slot):
+      for j in range(block):
+        fid = vf[slot, j, 0]
+        addr = jnp.clip(fid, 0, num_nodes)
+        pltpu.make_async_copy(ip_ref.at[pl.ds(addr, 2)],
+                              vip.at[slot, j], ipsem.at[slot, j]).start()
+
+    def wait_ip(slot):
+      for j in range(block):
+        pltpu.make_async_copy(vip.at[slot, j], vip.at[slot, j],
+                              ipsem.at[slot, j]).wait()
+
+    def start_windows(slot):
+      for j in range(block):
+        st = jnp.clip(vip[slot, j, 0], 0, num_edges)
+        for a in range(n_a):
+          pltpu.make_async_copy(src_refs[a].at[pl.ds(st, width)],
+                                win_bufs[a].at[slot, j],
+                                wsems[a].at[slot, j]).start()
+
+    def wait_windows(slot):
+      for j in range(block):
+        for a in range(n_a):
+          pltpu.make_async_copy(win_bufs[a].at[slot, j],
+                                win_bufs[a].at[slot, j],
+                                wsems[a].at[slot, j]).wait()
+
+    def fetch_block(hop, b, slot):
+      """Cold-start chain for a block with nothing prefetched (first
+      block of each hop — at a hop boundary the frontier is written by
+      the immediately preceding step, so there is nothing to overlap
+      with: the documented per-boundary pipeline bubble)."""
+      start_frontier(hop, b, slot)
+      wait_frontier(hop, slot)
+      start_ip(slot)
+      wait_ip(slot)
+      start_windows(slot)
+
+    # -- hop phases, statically unrolled --------------------------------
+    for hop in range(n_hops):
+      h = hops[hop]
+      k_h = h['k']
+
+      @pl.when((i >= h['step0']) & (i < h['step0'] + h['nb']))
+      def _(hop=hop, h=h, k_h=k_h):
+        b = i - h['step0']
+
+        @pl.when(b == 0)
+        def _():
+          fetch_block(hop, b, cur)
+
+        has_next = b + 1 < h['nb']
+
+        # next block's frontier starts resolving while this block's
+        # windows land and compute runs
+        @pl.when(has_next)
+        def _():
+          start_frontier(hop, b + 1, nxt)
+
+        wait_windows(cur)
+        ids_v = vf[cur][:, 0]                            # [block]
+        if hop == 0:
+          ok_v = vok[cur][:, 0] != 0
+        else:
+          ok_v = ids_v != big
+        rowpos = b * block + jax.lax.broadcasted_iota(
+            jnp.int32, (block,), 0)
+        ok_v = jnp.logical_and(ok_v, rowpos < h['s'])
+        ipv = vip[cur]                                   # [block, 2]
+        deg = jnp.where(ok_v, ipv[:, 1] - ipv[:, 0], 0)
+        u = u_refs[hop][...]                             # [block, K_h]
+        iota_k = jax.lax.broadcasted_iota(jnp.int32, (block, k_h), 1)
+        if replace:
+          off = jnp.minimum(
+              (u * deg[:, None].astype(u.dtype)).astype(jnp.int32),
+              jnp.maximum(deg[:, None] - 1, 0))
+          mask = jnp.broadcast_to(deg[:, None] > 0, (block, k_h))
+        else:
+          # Floyd's algorithm, vectorized over the block — literally
+          # ops/sample.py::_floyd_offsets on the [block] slice, so the
+          # offsets are bit-identical to every other engine's draw
+          cols = []
+          for j in range(k_h):
+            bound = jnp.maximum(deg - k_h + j, 0)
+            t = jnp.minimum(
+                (u[:, j] * (bound + 1).astype(u.dtype)).astype(
+                    jnp.int32), bound)
+            if cols:
+              prev_cols = jnp.stack(cols, axis=1)
+              dup = jnp.any(prev_cols == t[:, None], axis=1)
+            else:
+              dup = jnp.zeros((block,), bool)
+            cols.append(jnp.where(dup, bound, t))
+          sampled = jnp.stack(cols, axis=1)
+          off = jnp.where((deg <= k_h)[:, None], iota_k, sampled)
+          mask = iota_k < jnp.minimum(deg, k_h)[:, None]
+
+        # hub fix-up, per row: degree > W rows read their exact edge
+        # slots element-wise (no hub list, no cap — every hub row in
+        # the frontier is fixed, the per-hop engines' clamped-cap
+        # guarantee strengthened to unconditional)
+        for j in range(block):
+          deg_j = deg[j]
+          st_j = ipv[j, 0]
+
+          @pl.when(deg_j > width)
+          def _(j=j, st_j=st_j):
+            for kk in range(k_h):
+              sl = jnp.clip(st_j + off[j, kk], 0,
+                            max(num_edges - 1, 0))
+              for a in range(n_a):
+                pltpu.make_async_copy(src_refs[a].at[pl.ds(sl, 1)],
+                                      hub_bufs[a].at[j, pl.ds(kk, 1)],
+                                      hubsems[a].at[j, kk]).start()
+            for kk in range(k_h):
+              for a in range(n_a):
+                pltpu.make_async_copy(
+                    src_refs[a].at[pl.ds(0, 1)],
+                    hub_bufs[a].at[j, pl.ds(kk, 1)],
+                    hubsems[a].at[j, kk]).wait()
+
+        woff = jnp.minimum(off, width - 1)
+        iota3 = jax.lax.broadcasted_iota(jnp.int32, (block, k_h, width),
+                                         2)
+        onehot = iota3 == woff[:, :, None]
+        is_hub = deg > width
+        merged = []
+        for a in range(n_a):
+          win = win_bufs[a][cur]                         # [block, W]
+          zero = jnp.zeros((), win.dtype)
+          p = jnp.sum(jnp.where(onehot, win[:, None, :], zero),
+                      axis=-1)
+          hubfix = hub_bufs[a][...][:, :k_h].astype(win.dtype)
+          merged.append(jnp.where(is_hub[:, None], hubfix, p))
+
+        # next block's dependent chain resolves NOW, so its window DMAs
+        # overlap the probe section below — the one DMA pipeline that
+        # serves every hop
+        @pl.when(has_next)
+        def _(hop=hop):
+          wait_frontier(hop, nxt)
+          start_ip(nxt)
+          wait_ip(nxt)
+          start_windows(nxt)
+
+        # dedup stage against the walk-resident table, slot order
+        base = base_ref[0]
+        r = r_ref[0]
+        picks0 = merged[0]
+        lab_rows, new_rows = [], []
+        for j in range(block):
+          labs_k, newh_k = [], []
+          for kk in range(k_h):
+            x = picks0[j, kk].astype(jnp.int32)
+            v = mask[j, kk]
+            lab, is_new = _probe_insert(tids, tlabs, x, v, base + r,
+                                        n_buckets, lane)
+            labs_k.append(lab)
+            newh_k.append(is_new)
+            r = r + is_new
+          lab_rows.append(jnp.stack(labs_k))
+          new_rows.append(jnp.stack(newh_k))
+        r_ref[0] = r
+        lab_mat = jnp.stack(lab_rows)
+        new_mat = jnp.stack(new_rows)
+
+        picks_refs[hop][...] = picks0
+        if with_eids:
+          eidp_refs[hop][...] = merged[1]
+        prov_refs[hop][...] = lab_mat
+        newh_refs[hop][...] = new_mat
+
+        if hop < n_hops - 1:
+          # stage the next hop's frontier: first occurrences keep their
+          # id, everything else reads the sentinel row — exactly the
+          # where(new_head, ids, INT32_MAX) frontier of the sort engine
+          fscrs[hop][...] = jnp.where(new_mat != 0,
+                                      picks0.astype(jnp.int32), big)
+          dst = fp_refs[hop].at[pl.ds(b * block, block), :]
+          pltpu.make_async_copy(fscrs[hop], dst, fpsem.at[0]).start()
+          pltpu.make_async_copy(fscrs[hop], dst, fpsem.at[0]).wait()
+
+    # the walk's full output surface is the per-hop blocked outputs;
+    # nothing else leaves the kernel — in particular the table planes
+    # never touch HBM
+
+  def out_map(h):
+    step0, nb = h['step0'], h['nb']
+    return lambda i, *_: (jnp.clip(i - step0, 0, nb - 1), 0)
+
+  in_specs = (
+      [pl.BlockSpec((block, h['k']), out_map(h)) for h in hops]   # u
+      + [pl.BlockSpec(memory_space=pl.ANY)] * (3 + n_a))
+  out_specs = []
+  out_shapes = []
+  for fam_dtype in ([a.dtype for a in arrs]
+                    + [jnp.int32, jnp.int32]):
+    for h in hops:
+      out_specs.append(pl.BlockSpec((block, h['k']), out_map(h)))
+      out_shapes.append(
+          jax.ShapeDtypeStruct((h['s_pad'], h['k']), fam_dtype))
+  # frontier staging buffers (ANY, explicit DMA): one per hop boundary
+  n_fp = max(n_hops - 1, 1)
+  for t in range(n_fp):
+    h = hops[min(t, n_hops - 1)]
+    out_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+    out_shapes.append(
+        jax.ShapeDtypeStruct((h['s_pad'], h['k']), jnp.int32))
+
+  grid_spec = pltpu.PrefetchScalarGridSpec(
+      num_scalar_prefetch=3,
+      grid=(total_steps,),
+      in_specs=in_specs,
+      out_specs=out_specs,
+      scratch_shapes=(
+          [pltpu.VMEM((2, block, 1), jnp.int32),       # vf
+           pltpu.VMEM((2, block, 1), jnp.int32),       # vok
+           pltpu.VMEM((2, block, 2), jnp.int32)]       # vip
+          + [pltpu.VMEM((2, block, width), a.dtype) for a in arrs]
+          + [pltpu.VMEM((block, k_max), a.dtype) for a in arrs]
+          + [pltpu.VMEM((block, hops[t]['k']), jnp.int32)
+             for t in range(n_fp)]                     # fscr per hop
+          + [pltpu.VMEM(tshape, jnp.int32),            # tids
+             pltpu.VMEM(tshape, jnp.int32),            # tlabs
+             pltpu.SMEM((1,), jnp.int32),              # r
+             pltpu.SemaphoreType.DMA((2, block)),      # fsem
+             pltpu.SemaphoreType.DMA((2, block)),      # oksem
+             pltpu.SemaphoreType.DMA((2, block))]      # ipsem
+          + [pltpu.SemaphoreType.DMA((2, block)) for _ in arrs]
+          + [pltpu.SemaphoreType.DMA((block, k_max)) for _ in arrs]
+          + [pltpu.SemaphoreType.DMA((1,))]),          # fpsem
+  )
+  _count_launch()
+  outs = pl.pallas_call(
+      kernel,
+      grid_spec=grid_spec,
+      out_shape=out_shapes,
+      interpret=interpret,
+  )(seed_tab_ids, seed_tab_labs, base_count, *u_hops,
+    indptr_pad, seed_ids, seed_ok, *arrs)
+  picks = tuple(outs[:n_hops])
+  pos = n_hops
+  if with_eids:
+    eidp = tuple(outs[pos:pos + n_hops])
+    pos += n_hops
+  else:
+    eidp = None
+  prov = tuple(outs[pos:pos + n_hops]); pos += n_hops
+  newh = tuple(outs[pos:pos + n_hops])
+  return picks, eidp, prov, newh

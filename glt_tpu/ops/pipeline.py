@@ -71,6 +71,39 @@ def fused_hops() -> bool:
 HOP_ENGINES = ('element', 'window', 'pallas', 'pallas_fused')
 
 
+def fused_walk_mode() -> str:
+  """How the ``pallas_fused`` engine runs a multi-hop walk:
+
+  * ``cross`` (the ``auto`` default) — the cross-hop fused walk: the
+    WHOLE walk is one ``sample_walk_dedup`` kernel invocation whose
+    grid spans every hop's frontier blocks, with the VMEM dedup table
+    carried across hop boundaries (it never exists in HBM) and one
+    window-DMA pipeline serving every hop.
+  * ``per_hop`` — the unrolled per-hop kernel family
+    (``sample_hop_dedup`` once per hop, table planes round-tripping
+    HBM at each boundary) — the ISSUE-10 form, kept for A/B racing and
+    as the fallback for shapes the walk does not serve (full-
+    neighborhood/weighted hops never reach either form; an empty graph
+    routes per-hop, whose empty-input early-outs are exact).
+
+  ``GLT_FUSED_WALK=auto|cross|per_hop``; read at trace time like
+  :func:`dedup_engine`. ``auto`` resolves to ``cross`` on a compiled
+  TPU backend and ``per_hop`` under interpret mode: the walk's win is
+  on-chip table residency and launch collapse, which the interpreter
+  cannot deliver — it would only pay the (much larger) whole-walk
+  interpret compile on every CPU parity/CI run. Forced values apply
+  everywhere (the parity tests and the bench cost duel force
+  ``cross`` in interpret mode deliberately)."""
+  mode = os.environ.get('GLT_FUSED_WALK', 'auto')
+  if mode not in ('auto', 'cross', 'per_hop'):
+    raise ValueError(
+        f'GLT_FUSED_WALK={mode!r}: expected auto|cross|per_hop')
+  if mode == 'auto':
+    from .pallas_kernels import interpret_default
+    return 'per_hop' if interpret_default() else 'cross'
+  return mode
+
+
 #: env-level fallback events already counted this process — hop_engine()
 #: is read per hop per trace, and a per-read count would report one
 #: configuration event hops x traces times (sampler-level reasons
@@ -127,20 +160,47 @@ def hop_engine() -> str:
     overlays, table-overflow budgets) fall back to ``pallas`` with a
     counted ``hop_engine_fallbacks_total`` event.
 
-  ``GLT_HOP_ENGINE`` selects; ``auto`` (the default) is ``element``
-  until the hardware A/B (bench.py races the engines and records the
-  winner in its ``engines{}``) justifies flipping the default. All
-  engines draw offsets from the same ``jax.random`` stream, so results
-  are bit-identical (ops/sample.py; ``pallas_fused`` is bit-identical
-  to the ``sort+fused`` dedup engine, which it subsumes). Read at
-  trace time, like :func:`dedup_engine`."""
+  ``GLT_HOP_ENGINE`` selects; ``auto`` (the default) resolves PER
+  BACKEND: on CPU it stays ``element`` (the r5 microbench measured
+  XLA's element gather fastest there, and interpret-mode kernels are a
+  correctness harness, not a perf path); on TPU it resolves to the
+  best servable fused engine — ``pallas_fused`` — gated on a one-time
+  probe compile of the kernel family on the real backend
+  (``pallas_kernels.auto_probe_ok``), demoting to ``element`` with a
+  counted fallback if the probe fails. It deliberately never resolves
+  to ``window``: the XLA window gather measured 437 ms for 153k x 96
+  rows on a v5e (benchmarks/tpu_runs/microbench_prims_tpu2.json) — the
+  window read is only viable as a Pallas DMA. The resolution is
+  recorded once per process via
+  ``hop_engine_fallbacks_total{requested="auto",...}`` so the flip is
+  observable from a registry snapshot; ``GLT_HOP_ENGINE_AUTO=0`` is
+  the escape hatch pinning the legacy (element-everywhere) auto.
+
+  All engines draw offsets from the same ``jax.random`` stream, so
+  results are bit-identical (ops/sample.py; ``pallas_fused`` is
+  bit-identical to the ``sort+fused`` dedup engine, which it
+  subsumes). Read at trace time, like :func:`dedup_engine`."""
   mode = os.environ.get('GLT_HOP_ENGINE', 'auto')
   if mode not in ('auto',) + HOP_ENGINES:
     raise ValueError(
         f'GLT_HOP_ENGINE={mode!r}: expected '
         'auto|element|window|pallas|pallas_fused')
   if mode == 'auto':
-    return 'element'
+    if os.environ.get('GLT_HOP_ENGINE_AUTO', '1') in ('0', 'false'):
+      return 'element'
+    if jax.default_backend() != 'tpu':
+      return 'element'
+    from .pallas_kernels import auto_probe_ok, pallas_available
+    if not pallas_available():
+      key = ('auto', 'element', 'pallas_unimportable')
+    elif not auto_probe_ok():
+      key = ('auto', 'element', 'auto_probe_failed')
+    else:
+      key = ('auto', 'pallas_fused', 'auto_backend_tpu')
+    if key not in _COUNTED_ENV_FALLBACKS:  # one config event per
+      _COUNTED_ENV_FALLBACKS.add(key)      # process, not per read
+      count_engine_fallback(*key)
+    return key[1]
   if mode in ('pallas', 'pallas_fused'):
     from .pallas_kernels import pallas_available
     if not pallas_available():
@@ -414,6 +474,170 @@ def _multihop_sample_sorted(one_hop: OneHopFn,
   return out_dict
 
 
+def _fused_seed_hop(plan, seeds, n_valid, budget):
+  """The exact seed hop shared by both fused walk forms: sorted-path
+  seed dedup (``batch``/``seed_labels`` bit-identical to every engine)
+  plus, when the plan gathers, the seed rows' feature block. Returns
+  ``(d, seed_labels, feats|None)`` with ``d`` the raw
+  ``sorted_hop_dedup`` dict."""
+  big = jnp.iinfo(jnp.int32).max
+  batch_size = seeds.shape[0]
+  seed_mask = jnp.arange(batch_size) < n_valid
+  zero = jnp.zeros((0,), jnp.int32)
+  d = sorted_hop_dedup(zero, zero, jnp.zeros((), jnp.int32), seeds,
+                       seed_mask)
+  seed_labels = jax.lax.sort([d['pos3'], d['labels3']], num_keys=1)[1]
+  seed_labels = jnp.where(seed_mask, seed_labels, -1)
+  feats = None
+  if plan.gather_fn is not None:
+    feats = jnp.zeros((budget + 1, plan.feat_dim), plan.feat_dtype)
+    # seed rows in label order: one tiny [B] sort
+    lab_key = jnp.where(d['new_head3'], d['labels3'], big)
+    seed_sorted = jax.lax.sort(
+        [lab_key, jnp.where(d['new_head3'], d['ids3'], big)],
+        num_keys=1)[1]
+    feats = _gather_fresh_rows(feats, plan.gather_fn, seed_sorted,
+                               jnp.zeros((), jnp.int32), d['count2'],
+                               budget)
+  return d, seed_labels, feats
+
+
+def _fused_output_dict(plan, nodes, count, cols_child, rows_parent,
+                       emasks, eid_list, batch_size, seed_labels,
+                       seed_count, hop_node_counts, hop_edge_counts,
+                       feats, with_edge, budget):
+  """Assemble the multihop output surface shared by the per-hop fused
+  loop and the cross-hop walk (identical contract, one constructor)."""
+  out_dict = dict(
+      node=nodes,
+      node_count=count,
+      row=jnp.concatenate(cols_child),
+      col=jnp.concatenate(rows_parent),
+      edge_mask=jnp.concatenate(emasks),
+      batch=jax.lax.slice(nodes, (0,), (batch_size,)),
+      seed_labels=seed_labels,
+      seed_count=seed_count,
+      num_sampled_nodes=jnp.stack(hop_node_counts),
+      num_sampled_edges=jnp.stack(hop_edge_counts),
+  )
+  if with_edge:
+    out_dict['edge'] = jnp.concatenate(eid_list)
+  if feats is not None:
+    # padded lanes (label >= count) must match the post-hoc gather at
+    # node == -1 bit-for-bit, so parity with gather_features holds on
+    # EVERY lane, not just the live prefix
+    pad_row = plan.gather_fn(jnp.full((1,), -1, jnp.int32))
+    lanes = jnp.arange(budget) < count
+    out_dict['node_feats'] = jnp.where(
+        lanes[:, None], feats[:budget], pad_row.astype(feats.dtype))
+  return out_dict
+
+
+def _multihop_sample_walk(plan, seeds, n_valid, fanouts, key,
+                          with_edge: bool = False):
+  """The CROSS-HOP fused walk (GLT_FUSED_WALK=cross, the default): one
+  ``sample_walk_dedup`` kernel invocation runs every uniform hop —
+  window DMA, offset pick, hub fix-up and dedup-table assign — with
+  the table resident in VMEM across hop boundaries. The XLA epilogue
+  restores the exact ``sorted_hop_dedup_fused`` label contract with an
+  incremental remap table: per hop, one narrow [M_h] sort ranks the
+  fresh ids by value, the (provisional -> final) mapping accumulates
+  into ``R``, and every hop's emitted labels are one gather through
+  ``R`` — no per-hop table rewrite exists because the table's
+  provisional labels never leave the kernel. Outputs bit-identical to
+  ``sort+fused`` and to the per-hop form on every surface (asserted in
+  interpret mode by tests/test_pallas_fused.py)."""
+  from .pallas_kernels import sample_walk_dedup, walk_geometry
+  from .sample import hop_valid_mask, walk_hop_uniforms, \
+      _value_order_ranks
+  big = jnp.iinfo(jnp.int32).max
+  batch_size = seeds.shape[0]
+  budget = sample_budget(batch_size, fanouts)
+  d, seed_labels, feats = _fused_seed_hop(plan, seeds, n_valid, budget)
+  seed_count = d['count2']
+  u_ids, u_labs = d['u_ids2'], d['u_labs2']
+  count = seed_count
+  num_edges = int(plan.indices.shape[0])
+
+  hops, _ = walk_geometry(batch_size, fanouts)
+  u_hops = walk_hop_uniforms(key, batch_size, fanouts, plan.replace)
+  s1_pad = hops[0]['s_pad']
+  pad1 = s1_pad - batch_size
+  seed_ids = jnp.pad(d['ids3'].astype(jnp.int32), (0, pad1),
+                     constant_values=big)
+  seed_ok = jnp.pad(d['new_head3'].astype(jnp.int32), (0, pad1))
+  stab_ids = jnp.pad(
+      jnp.where(d['new_head3'], d['ids3'].astype(jnp.int32), -1),
+      (0, pad1), constant_values=-1)
+  stab_labs = jnp.pad(d['labels3'].astype(jnp.int32), (0, pad1))
+
+  picks_t, eidp_t, prov_t, newh_t = sample_walk_dedup(
+      plan.indices_win,
+      plan.edge_ids_win if plan.edge_ids is not None else None,
+      plan.indptr_pad, seed_ids, seed_ok, stab_ids, stab_labs,
+      seed_count, u_hops,
+      fanouts=tuple(int(f) for f in fanouts), width=plan.width,
+      num_nodes=int(plan.indptr.shape[0]) - 1, num_edges=num_edges,
+      table_slots=plan.table_slots, batch_size=batch_size,
+      replace=plan.replace, interpret=plan.interpret)
+
+  # XLA epilogue: per hop, recompute the draw mask from the shared
+  # degree formula, rank the fresh ids by value, extend the
+  # provisional->final remap, and emit the final-label surfaces
+  remap = jnp.arange(budget + 1, dtype=jnp.int32)  # seeds: identity
+  frontier_ids = d['ids3']
+  frontier_mask = d['new_head3']
+  frontier_labels = d['labels3']
+  rows_parent, cols_child, emasks, eid_list = [], [], [], []
+  hop_node_counts = [seed_count]
+  hop_edge_counts = []
+  for h_idx, fanout in enumerate(fanouts):
+    h = hops[h_idx]
+    s_h, k_h = h['s'], h['k']
+    m_h = s_h * k_h
+    picks = picks_t[h_idx][:s_h]
+    prov_flat = prov_t[h_idx][:s_h].reshape(-1)
+    nh = newh_t[h_idx][:s_h].reshape(-1) != 0
+    ids_flat = picks.reshape(-1).astype(jnp.int32)
+    mask = hop_valid_mask(plan.indptr, frontier_ids, k_h,
+                          frontier_mask, plan.replace)
+    mask_flat = mask.reshape(-1)
+    sorted_new_ids, val_rank = _value_order_ranks(
+        ids_flat, nh, prov_flat - count, m_h)
+    final = count + jnp.take(
+        val_rank, jnp.clip(prov_flat - count, 0, m_h - 1))
+    remap = remap.at[jnp.where(nh, prov_flat, budget)].set(
+        jnp.where(nh, final, remap[budget]))
+    labels3 = jnp.where(
+        mask_flat, jnp.take(remap, jnp.clip(prov_flat, 0, budget)), -1)
+    new_count = nh.sum(dtype=jnp.int32)
+
+    rows_parent.append(jnp.repeat(frontier_labels, k_h))
+    cols_child.append(labels3)
+    emasks.append(mask_flat)
+    if with_edge:
+      eid_list.append(eidp_t[h_idx][:s_h].reshape(-1))
+    u_ids = jnp.concatenate([u_ids, jnp.where(nh, ids_flat, big)])
+    u_labs = jnp.concatenate([u_labs, jnp.where(nh, labels3, big)])
+    if feats is not None:
+      with jax.named_scope(f'gather_walk{h_idx}'):
+        feats = _gather_fresh_rows(feats, plan.gather_fn,
+                                   sorted_new_ids, count, new_count,
+                                   budget)
+    hop_node_counts.append(new_count)
+    hop_edge_counts.append(mask_flat.sum().astype(jnp.int32))
+    frontier_ids = jnp.where(nh, ids_flat, big)
+    frontier_mask = nh
+    frontier_labels = labels3
+    count = count + new_count
+
+  nodes = sorted_nodes_by_label(u_ids, u_labs, count, budget)
+  return _fused_output_dict(
+      plan, nodes, count, cols_child, rows_parent, emasks, eid_list,
+      batch_size, seed_labels, seed_count, hop_node_counts,
+      hop_edge_counts, feats, with_edge, budget)
+
+
 def _multihop_sample_fused(plan, seeds, n_valid, fanouts, key,
                            with_edge: bool = False):
   """The hop loop on the ``pallas_fused`` kernel family: the seed hop
@@ -426,18 +650,26 @@ def _multihop_sample_fused(plan, seeds, n_valid, fanouts, key,
   tests/test_pallas_fused.py. With ``plan.gather_fn``, each hop's fresh
   unique rows are feature-gathered while the walk runs and assembled
   into ``node_feats`` (label order = row order, exactly
-  ``gather_features(feat, node)`` including the padded-lane values)."""
+  ``gather_features(feat, node)`` including the padded-lane values).
+
+  Under ``GLT_FUSED_WALK=cross`` (the default) a walk whose shapes the
+  cross-hop kernel serves — uniform positive fanouts over a non-empty
+  graph — routes to :func:`_multihop_sample_walk` instead: ONE kernel
+  invocation for the whole walk, the dedup table never leaving VMEM."""
+  if (fused_walk_mode() == 'cross' and plan.indices.shape[0] > 0
+      and len(fanouts) > 0 and all(int(f) > 0 for f in fanouts)
+      and (not with_edge or plan.edge_ids is not None)):
+    # with_edge over a graph WITHOUT an edge-id plane stays per-hop:
+    # its eids contract is the raw CSR slots, which only exist where
+    # the offsets do — in the per-hop wrapper's XLA prologue (the walk
+    # draws offsets on-chip and never materializes slots)
+    return _multihop_sample_walk(plan, seeds, n_valid, fanouts, key,
+                                 with_edge=with_edge)
   big = jnp.iinfo(jnp.int32).max
   batch_size = seeds.shape[0]
   budget = sample_budget(batch_size, fanouts)
-  seed_mask = jnp.arange(batch_size) < n_valid
 
-  u_ids = jnp.zeros((0,), jnp.int32)
-  u_labs = jnp.zeros((0,), jnp.int32)
-  count = jnp.zeros((), jnp.int32)
-  d = sorted_hop_dedup(u_ids, u_labs, count, seeds, seed_mask)
-  seed_labels = jax.lax.sort([d['pos3'], d['labels3']], num_keys=1)[1]
-  seed_labels = jnp.where(seed_mask, seed_labels, -1)
+  d, seed_labels, feats = _fused_seed_hop(plan, seeds, n_valid, budget)
   seed_count = d['count2']
   u_ids, u_labs, count = d['u_ids2'], d['u_labs2'], d['count2']
   frontier_ids = d['ids3']
@@ -446,18 +678,6 @@ def _multihop_sample_fused(plan, seeds, n_valid, fanouts, key,
   table = plan.init_table(jnp.where(d['new_head3'], d['ids3'], -1),
                           d['labels3'],
                           d['new_head3'].astype(jnp.int32))
-
-  feats = None
-  if plan.gather_fn is not None:
-    feats = jnp.zeros((budget + 1, plan.feat_dim), plan.feat_dtype)
-    # seed rows in label order: one tiny [B] sort
-    lab_key = jnp.where(d['new_head3'], d['labels3'], big)
-    seed_sorted = jax.lax.sort(
-        [lab_key, jnp.where(d['new_head3'], d['ids3'], big)],
-        num_keys=1)[1]
-    feats = _gather_fresh_rows(feats, plan.gather_fn, seed_sorted,
-                               jnp.zeros((), jnp.int32), seed_count,
-                               budget)
 
   rows_parent, cols_child, emasks, eid_list = [], [], [], []
   hop_node_counts = [seed_count]
@@ -494,29 +714,10 @@ def _multihop_sample_fused(plan, seeds, n_valid, fanouts, key,
     count = dd['count2']
 
   nodes = sorted_nodes_by_label(u_ids, u_labs, count, budget)
-  out_dict = dict(
-      node=nodes,
-      node_count=count,
-      row=jnp.concatenate(cols_child),
-      col=jnp.concatenate(rows_parent),
-      edge_mask=jnp.concatenate(emasks),
-      batch=jax.lax.slice(nodes, (0,), (batch_size,)),
-      seed_labels=seed_labels,
-      seed_count=seed_count,
-      num_sampled_nodes=jnp.stack(hop_node_counts),
-      num_sampled_edges=jnp.stack(hop_edge_counts),
-  )
-  if with_edge:
-    out_dict['edge'] = jnp.concatenate(eid_list)
-  if feats is not None:
-    # padded lanes (label >= count) must match the post-hoc gather at
-    # node == -1 bit-for-bit, so parity with gather_features holds on
-    # EVERY lane, not just the live prefix
-    pad_row = plan.gather_fn(jnp.full((1,), -1, jnp.int32))
-    lanes = jnp.arange(budget) < count
-    out_dict['node_feats'] = jnp.where(lanes[:, None], feats[:budget],
-                                       pad_row)
-  return out_dict
+  return _fused_output_dict(
+      plan, nodes, count, cols_child, rows_parent, emasks, eid_list,
+      batch_size, seed_labels, seed_count, hop_node_counts,
+      hop_edge_counts, feats, with_edge, budget)
 
 
 def _gather_fresh_rows(feats, gather_fn, ids_sorted, base, n_new,

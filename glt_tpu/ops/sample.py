@@ -45,8 +45,9 @@ def _empty_output(s: int, width: int, indices, edge_ids,
                   indptr) -> 'NeighborOutput':
   """All-masked output for a zero-edge graph; dtypes follow the same
   contract as the non-empty paths (nbrs: indices.dtype, eids:
-  edge_ids.dtype, or the indptr-derived slot dtype)."""
-  eid_dtype = edge_ids.dtype if edge_ids is not None else indptr.dtype
+  edge_ids.dtype, or int32 — slot planes are int32 throughout the hot
+  path)."""
+  eid_dtype = edge_ids.dtype if edge_ids is not None else jnp.int32
   return NeighborOutput(nbrs=jnp.zeros((s, width), indices.dtype),
                         mask=jnp.zeros((s, width), bool),
                         eids=jnp.full((s, width), -1, eid_dtype))
@@ -73,16 +74,39 @@ def _floyd_offsets(deg: jax.Array, u: jax.Array, fanout: int) -> jax.Array:
   return chosen
 
 
-def _draw_hop(indptr, seeds, fanout, key, seed_mask, replace):
-  """The one uniform-hop offset draw shared by EVERY hop engine: degree
-  window, Floyd/replace offsets, validity mask, absolute edge slots.
-  Keeping this in one place is what makes the engines bit-identical —
-  they differ only in WHERE neighbor values are read from."""
+def _hop_degrees(indptr, seeds, seed_mask):
+  """Window start + masked degree per frontier row — the shared prefix
+  of every engine's draw. Factored out so the cross-hop walk's XLA-side
+  mask recomputation (ops/pipeline.py::_multihop_sample_walk) uses the
+  LITERAL same clip/mask semantics as the draw it mirrors."""
   start = jnp.take(indptr, seeds, mode='clip')
   end = jnp.take(indptr, seeds + 1, mode='clip')
   deg = (end - start).astype(jnp.int32)
   if seed_mask is not None:
     deg = jnp.where(seed_mask, deg, 0)
+  return start, deg
+
+
+def hop_valid_mask(indptr, seeds, fanout, seed_mask, replace):
+  """The draw's validity mask WITHOUT the offset draw: [S, K] lanes
+  valid exactly where :func:`_draw_hop` would mark them. The cross-hop
+  walk kernel computes its masks on-chip from the same degree formula;
+  this recomputation (two [S] gathers) is what the XLA side uses for
+  ``edge_mask`` so both derive from one definition."""
+  seeds = seeds.astype(indptr.dtype)
+  _, deg = _hop_degrees(indptr, seeds, seed_mask)
+  if replace:
+    return jnp.broadcast_to(deg[:, None] > 0, (seeds.shape[0], fanout))
+  iota = jnp.arange(fanout, dtype=jnp.int32)[None, :]
+  return iota < jnp.minimum(deg, fanout)[:, None]
+
+
+def _draw_hop(indptr, seeds, fanout, key, seed_mask, replace):
+  """The one uniform-hop offset draw shared by EVERY hop engine: degree
+  window, Floyd/replace offsets, validity mask, absolute edge slots.
+  Keeping this in one place is what makes the engines bit-identical —
+  they differ only in WHERE neighbor values are read from."""
+  start, deg = _hop_degrees(indptr, seeds, seed_mask)
   iota = jnp.arange(fanout, dtype=jnp.int32)[None, :]    # [1, K]
   if replace:
     u = jax.random.uniform(key, (seeds.shape[0], fanout))
@@ -110,6 +134,20 @@ def _hub_fixup_inputs(deg, slots, w_width, n_hub, fanout, s):
     hub_idx = jnp.full((1,), -1, jnp.int32)
     hub_slots = jnp.zeros((1, fanout), jnp.int32)
   return hub_idx, hub_slots
+
+
+def _slots_i32(start, offsets, num_edges):
+  """Absolute edge slots, narrowed to int32 — half the index bytes on
+  the hot path. The narrowing is only sound while the edge count fits
+  int32; ``num_edges`` is static, so the guard is a free trace-time
+  assert that fails LOUDLY instead of letting slots wrap to negative
+  (which take-clip would silently clamp to edge 0 — corrupt samples)."""
+  assert num_edges < 2 ** 31, (
+      f'{num_edges} edges exceed the int32 slot range: the hot-path '
+      'slot planes are int32 by design — shard the graph (the '
+      'distributed partitioner splits well before 2^31 edges/shard)')
+  return jnp.clip(start[:, None] + offsets.astype(start.dtype),
+                  0, max(num_edges - 1, 0)).astype(jnp.int32)
 
 
 def _gather_row_windows(src: jax.Array, start: jax.Array,
@@ -204,8 +242,11 @@ def sample_neighbors(
                          indptr)
   start, deg, offsets, mask = _draw_hop(indptr, seeds, fanout, key,
                                         seed_mask, replace)
-  slots = jnp.clip(start[:, None] + offsets.astype(start.dtype),
-                   0, max(num_edges - 1, 0))
+  # int32 everywhere edge slots flow: a shard's edge count fits int32
+  # by construction in this stack (the partitioner splits well before
+  # 2^31 edges/shard), so an int64 indptr must not widen the [S, K]
+  # slot/eid planes it feeds — half the index bytes on the hot path
+  slots = _slots_i32(start, offsets, num_edges)
   if window is not None:
     w_width, n_hub = window
     assert indices_win is not None, (
@@ -269,6 +310,47 @@ def sample_neighbors(
 _BIG_I32 = jnp.iinfo(jnp.int32).max
 
 
+def walk_hop_uniforms(key, batch_size, fanouts, replace, block=8):
+  """Per-hop uniform draws for the cross-hop walk kernel, from the SAME
+  key sequence as the per-hop loop (``key, sub = split(key)`` per hop,
+  ``uniform(sub, (K, S))`` for Floyd / ``(S, K)`` for replace — see
+  :func:`_draw_hop`). The draws are data-independent, which is what
+  lets the whole walk's randomness be staged up front while the
+  frontier itself is produced on-chip. Returned in the kernel's
+  [S_pad, K] row-major orientation (Floyd draws transposed), rows
+  block-padded with zeros."""
+  from .pallas_kernels import walk_geometry
+  hops, _ = walk_geometry(batch_size, fanouts, block)
+  us = []
+  for h in hops:
+    key, sub = jax.random.split(key)
+    if replace:
+      u = jax.random.uniform(sub, (h['s'], h['k']))
+    else:
+      u = jax.random.uniform(sub, (h['k'], h['s'])).T
+    us.append(jnp.pad(u, ((0, h['s_pad'] - h['s']), (0, 0))))
+  return tuple(us)
+
+
+def _value_order_ranks(ids_flat, new_head, prov_rank, m):
+  """The value-order relabel core shared by the per-hop fused wrapper
+  and the cross-hop walk: given a hop's fresh-id heads (``new_head``),
+  their within-hop first-occurrence ranks (``prov_rank``) and ids,
+  return ``(sorted_ids, val_rank)`` where ``sorted_ids`` is the fresh
+  unique ids ascending (_BIG padded — the fused feature gather consumes
+  these directly) and ``val_rank[first_occurrence_rank] = value rank``.
+  One 2-operand sort over [M] — the only sort in a fused hop."""
+  first_rank = jnp.where(new_head, prov_rank, m)        # pads -> sink
+  new_by_rank = jnp.full((m + 1,), _BIG_I32, jnp.int32).at[
+      first_rank].set(jnp.where(new_head, ids_flat, _BIG_I32))[:m]
+  iota = jnp.arange(m, dtype=jnp.int32)
+  sorted_ids, sorted_rank = jax.lax.sort([new_by_rank, iota],
+                                         num_keys=1)
+  val_rank = jnp.zeros((m + 1,), jnp.int32).at[
+      jnp.where(sorted_ids < _BIG_I32, sorted_rank, m)].set(iota)[:m]
+  return sorted_ids, val_rank
+
+
 def sample_neighbors_fused(
     indptr: jax.Array,
     indices: jax.Array,
@@ -328,8 +410,7 @@ def sample_neighbors_fused(
     return out, d, (tab_ids, tab_labs)
   start, deg, offsets, mask = _draw_hop(indptr, seeds, fanout, key,
                                         seed_mask, replace)
-  slots = jnp.clip(start[:, None] + offsets.astype(start.dtype),
-                   0, max(num_edges - 1, 0))
+  slots = _slots_i32(start, offsets, num_edges)
   assert edge_ids is None or edge_ids_win is not None, (
       'fused engine with edge_ids needs edge_ids_win (the W-padded '
       'edge-id array, Graph.window_arrays()["edge_ids"])')
@@ -351,14 +432,8 @@ def sample_neighbors_fused(
   m_flat = mask.reshape(-1)
   prov_flat = prov.reshape(-1)
   nh = new_head.reshape(-1) != 0
-  first_rank = jnp.where(nh, prov_flat - count, m)      # pads -> sink
-  new_by_rank = jnp.full((m + 1,), _BIG_I32, jnp.int32).at[
-      first_rank].set(jnp.where(nh, ids_flat, _BIG_I32))[:m]
-  iota = jnp.arange(m, dtype=jnp.int32)
-  sorted_ids, sorted_rank = jax.lax.sort([new_by_rank, iota],
-                                         num_keys=1)
-  val_rank = jnp.zeros((m + 1,), jnp.int32).at[
-      jnp.where(sorted_ids < _BIG_I32, sorted_rank, m)].set(iota)[:m]
+  sorted_ids, val_rank = _value_order_ranks(ids_flat, nh,
+                                            prov_flat - count, m)
   is_new_el = m_flat & (prov_flat >= count)
   labels3 = jnp.where(
       is_new_el,
@@ -400,12 +475,23 @@ class FusedHopPlan:
       fresh rows while the walk is still running and emits
       ``node_feats`` alongside the sample.
     feat_dim / feat_dtype: static output geometry for ``gather_fn``.
+      ``feat_dtype`` may NARROW the store dtype (the opt-in bf16 gather
+      plane, ``GLT_FUSED_FEAT_DTYPE=bfloat16``): the in-walk plane and
+      the emitted ``node_feats`` then carry the narrow dtype, halving
+      the gather's HBM write traffic — parity with the post-hoc
+      ``gather_features`` holds after casting the reference (documented
+      precision trade, default off).
+    indptr_pad: optional [N + 2] int32 CSR offsets with a trailing
+      ``num_edges`` sentinel — the cross-hop walk kernel's row-window
+      source (see ``sample_walk_dedup``). Built eagerly here when not
+      passed (plans are constructed outside jit, so the pad is a
+      one-time host/device op, never a leaked tracer).
   """
 
   def __init__(self, indptr, indices, indices_win, width, hub_count,
                table_slots, edge_ids=None, edge_ids_win=None,
                replace=False, interpret=False, gather_fn=None,
-               feat_dim=None, feat_dtype=None):
+               feat_dim=None, feat_dtype=None, indptr_pad=None):
     self.indptr = indptr
     self.indices = indices
     self.indices_win = indices_win
@@ -419,6 +505,12 @@ class FusedHopPlan:
     self.gather_fn = gather_fn
     self.feat_dim = feat_dim
     self.feat_dtype = feat_dtype
+    if indptr_pad is None:
+      num_edges = int(indices.shape[0])
+      indptr_pad = jnp.concatenate(
+          [jnp.asarray(indptr, jnp.int32),
+           jnp.full((1,), num_edges, jnp.int32)])
+    self.indptr_pad = indptr_pad
 
   def init_table(self, ids, labs, valid):
     """Fresh table planes seeded with the exact-dedup'd seed hop."""
@@ -483,10 +575,9 @@ def sample_full_neighbors(
     if edge_ids is not None:
       eids = window_gather(window_sources['edge_ids'], start, max_degree)
     else:
-      eids = start[:, None] + win.astype(start.dtype)
+      eids = _slots_i32(start, win, num_edges)
     return NeighborOutput(nbrs=nbrs, mask=mask, eids=eids)
-  slots = jnp.clip(start[:, None] + win.astype(start.dtype),
-                   0, max(num_edges - 1, 0))
+  slots = _slots_i32(start, win, num_edges)
   nbrs = jnp.take(indices, slots, mode='clip')
   eids = jnp.take(edge_ids, slots, mode='clip') if edge_ids is not None \
       else slots
@@ -548,7 +639,9 @@ def sample_neighbors_weighted(
   _, top = jax.lax.top_k(keys, fanout)                    # [S, K] window idx
   top_valid = jnp.take_along_axis(keys, top, axis=1) > -jnp.inf
   off = top.astype(start.dtype)
-  pick = jnp.clip(start[:, None] + off, 0, max(num_edges - 1, 0))
+  # int32 edge slots (see _slots_i32): the weighted path's picks were
+  # the residual wide operands in the slots/labels flow
+  pick = _slots_i32(start, off, num_edges)
   nbrs = jnp.take(indices, pick, mode='clip')
   eids = jnp.take(edge_ids, pick, mode='clip') if edge_ids is not None \
       else pick

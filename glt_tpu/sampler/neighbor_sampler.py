@@ -218,10 +218,16 @@ class NeighborSampler(BaseSampler):
   def _count_fallback(self, reason: str, resolved: str = 'pallas'):
     """Once-per-(sampler, reason) engine-fallback accounting — the
     event is a property of the sampler's configuration, so repeating it
-    per hop/call would just inflate the counter."""
+    per hop/call would just inflate the counter. The ``requested``
+    label carries what the operator actually asked for (``auto`` when
+    the backend-aware default resolved to the fused engine), so a
+    dashboard can tell a deliberate engine request from a default."""
     if reason not in self._fallbacks_counted:
       self._fallbacks_counted.add(reason)
-      count_engine_fallback('pallas_fused', resolved, reason)
+      requested = os.environ.get('GLT_HOP_ENGINE', 'auto')
+      if getattr(self, '_hop_engine_override', None):
+        requested = self._hop_engine_override
+      count_engine_fallback(requested, resolved, reason)
 
   def _resolved_hop_engine(self) -> str:
     """The engine this sampler ACTUALLY runs: ``pallas_fused`` demotes
@@ -275,6 +281,9 @@ class NeighborSampler(BaseSampler):
       return None
     budget = sample_budget(batch_size, self.num_neighbors)
     slots = fused_table_slots(budget)
+    # geometry gauges BEFORE the overflow gate: an over-knob walk is
+    # exactly the one whose chosen-slots-vs-knob distance matters
+    self._publish_table_geometry(slots)
     if slots > fused_table_max_slots():
       self._count_fallback('table_overflow')
       return None
@@ -284,13 +293,73 @@ class NeighborSampler(BaseSampler):
       gather_fn = feat.fused_gather_fn(row_gather=self.row_gather)
       feat_dim = feat.feature_dim
       feat_dtype = feat.device_part.dtype
+      # opt-in narrow gather plane: the in-walk feature block (and the
+      # emitted node_feats) carry this dtype, halving the gather's HBM
+      # write traffic for float32 stores. A widening request is
+      # ignored — the plane never up-converts.
+      narrow = os.environ.get('GLT_FUSED_FEAT_DTYPE')
+      if narrow:
+        narrow = jnp.dtype(narrow)
+        if narrow.itemsize < jnp.dtype(feat_dtype).itemsize:
+          feat_dtype = narrow
+    self._table_slots = slots
     return FusedHopPlan(
         g.indptr, g.indices, sources['indices'], width,
         g.hub_count(width), slots,
         edge_ids=g.edge_ids if self.with_edge else None,
         edge_ids_win=sources.get('edge_ids'), replace=self.replace,
         interpret=interpret_default(), gather_fn=gather_fn,
-        feat_dim=feat_dim, feat_dtype=feat_dtype)
+        feat_dim=feat_dim, feat_dtype=feat_dtype,
+        indptr_pad=g.indptr_pad())
+
+  def _publish_table_geometry(self, slots: int) -> None:
+    """Registry gauges for the fused dedup table's static geometry —
+    chosen slot count and VMEM bytes (both planes) — so a
+    ``table_overflow`` demotion is diagnosable from a registry snapshot
+    (how close was the walk to the knob?) instead of only a fallback
+    counter."""
+    try:
+      from ..obs import get_registry
+      from ..ops.pallas_kernels import fused_table_max_slots
+      reg = get_registry()
+      reg.gauge('fused_table_slots').set(float(slots))
+      reg.gauge('fused_table_vmem_bytes').set(float(2 * slots * 4))
+      reg.gauge('fused_table_max_slots').set(
+          float(fused_table_max_slots()))
+    except Exception:  # metrics must never break sampling
+      pass
+
+  def _update_table_occupancy(self, out) -> None:
+    """Occupancy high-water gauge for the fused table: the walk's
+    distinct-node count over the table's slot capacity. Reading the
+    count forces a device sync, so this only runs when the tracer is
+    already sampling syncs (GLT_OBS_TRACE_SAMPLE) or under the explicit
+    ``GLT_OBS_TABLE_OCCUPANCY=1`` opt-in — steady-state sampling stays
+    fully async."""
+    slots = getattr(self, '_table_slots', None)
+    if not slots:
+      return
+    try:
+      from ..obs import get_registry, get_tracer
+      if os.environ.get('GLT_OBS_TABLE_OCCUPANCY', '') not in (
+          '1', 'true'):
+        t = get_tracer()
+        # mirror the tracer's own probabilistic sync draw: reading the
+        # count blocks on the walk, so it must happen on the SAMPLED
+        # FRACTION of calls, not on every call while sampling is on
+        import random
+        if not (t.enabled and t._sample > 0
+                and random.random() < t._sample):
+          return
+      occ = int(out['node_count'])
+      hwm = max(getattr(self, '_table_occ_hwm', 0), occ)
+      self._table_occ_hwm = hwm
+      reg = get_registry()
+      reg.gauge('fused_table_occupancy_hwm').set(float(hwm))
+      reg.gauge('fused_table_occupancy_ratio_hwm').set(
+          float(hwm) / float(slots))
+    except Exception:  # metrics must never break sampling
+      pass
 
   def _uniform_hop_kwargs(self, g: Graph, frontier_size: int):
     """Windowed-engine plumbing for the UNIFORM hop read
@@ -411,6 +480,7 @@ class NeighborSampler(BaseSampler):
           kwargs.get('key', self._next_key()), table, scratch)
       _synced['out'] = out['num_sampled_edges']
     self._tables[''] = (table, scratch)
+    self._update_table_occupancy(out)
     metadata = {'seed_labels': out['seed_labels'],
                 'seed_count': out['seed_count']}
     if 'node_feats' in out:

@@ -190,7 +190,9 @@ class StreamSampler(BaseSampler):
       if not getattr(self, '_fused_fallback_counted', False):
         self._fused_fallback_counted = True
         from ..ops.pipeline import count_engine_fallback
-        count_engine_fallback('pallas_fused', 'pallas', 'stream_overlay')
+        requested = (getattr(self, '_hop_engine_override', None)
+                     or os.environ.get('GLT_HOP_ENGINE', 'auto'))
+        count_engine_fallback(requested, 'pallas', 'stream_overlay')
       eng = 'pallas'
     if eng == 'element' or not any(f > 0 for f in self._base_fanouts):
       return ('element', 0, 0)

@@ -367,6 +367,259 @@ def test_fallback_counters_for_unservable_shapes(monkeypatch):
     set_registry(prev)
 
 
+# -- cross-hop fused walk (ISSUE 13) ------------------------------------
+#
+# GLT_FUSED_WALK=cross runs the WHOLE walk as one sample_walk_dedup
+# kernel (table resident in VMEM across hops); auto resolves to per_hop
+# under interpret mode, so every cross-walk test forces the knob.
+
+
+def test_walk_bit_identical_to_sort_fused(monkeypatch):
+  monkeypatch.setenv('GLT_FUSED_WALK', 'cross')
+  g = _graph(seed=2)
+  seeds = jnp.asarray(np.array([5, 0, 5, 17, 63, 2, 2, 9], np.int32))
+  nv = jnp.asarray(7)
+  key = jax.random.key(9)
+  fanouts = (3, 2)
+  ref = _ref_sort_fused(g, seeds, nv, fanouts, key, monkeypatch,
+                        with_edge=True)
+  table, scratch = make_dedup_tables(g['n'])
+  got, _, _ = multihop_sample(
+      None, seeds, nv, fanouts, key, table, scratch, with_edge=True,
+      fused_plan=_plan(g, fanouts, seeds.shape[0], with_edge=True))
+  for k in EXACT_KEYS:
+    np.testing.assert_array_equal(ref[k], np.asarray(got[k]),
+                                  err_msg=k)
+  m = ref['edge_mask'].astype(bool)
+  np.testing.assert_array_equal(ref['edge'][m],
+                                np.asarray(got['edge'])[m])
+
+
+@pytest.mark.slow  # interpret-mode walk traces are minutes on 1 CPU;
+                   # the pallas-interpret CI job (-m pallas) runs this
+def test_walk_full_window_parity_and_replace(monkeypatch):
+  # against a window-read reference even masked-lane junk matches (the
+  # walk reads the same physical window slots, incl. duplicate-seed
+  # rows which keep their REAL windows on hop 1); replace rides the
+  # in-kernel replace offset formula
+  monkeypatch.setenv('GLT_FUSED_WALK', 'cross')
+  g = _graph(seed=3)
+  seeds = jnp.asarray(np.arange(10, dtype=np.int32))
+  nv = jnp.asarray(10)
+  key = jax.random.key(1)
+  fanouts = (3, 2)
+  ref = _ref_sort_fused(g, seeds, nv, fanouts, key, monkeypatch,
+                        with_edge=True, window_read=True)
+  table, scratch = make_dedup_tables(g['n'])
+  got, _, _ = multihop_sample(
+      None, seeds, nv, fanouts, key, table, scratch, with_edge=True,
+      fused_plan=_plan(g, fanouts, seeds.shape[0], with_edge=True))
+  np.testing.assert_array_equal(ref['edge'], np.asarray(got['edge']))
+  # replace draw, plus a fully-masked batch through the walk
+  refr = _ref_sort_fused(g, seeds, jnp.asarray(4), (4,), key,
+                         monkeypatch, replace=True)
+  gotr, _, _ = multihop_sample(
+      None, seeds, jnp.asarray(4), (4,), key, table, scratch,
+      fused_plan=_plan(g, (4,), seeds.shape[0], replace=True))
+  for k in EXACT_KEYS:
+    np.testing.assert_array_equal(refr[k], np.asarray(gotr[k]),
+                                  err_msg=k)
+  got0, _, _ = multihop_sample(
+      None, seeds, jnp.asarray(0), fanouts, key, table, scratch,
+      fused_plan=_plan(g, fanouts, seeds.shape[0]))
+  assert int(got0['node_count']) == 0
+
+
+@pytest.mark.slow  # see test_walk_full_window_parity_and_replace
+def test_walk_scan_entry_parity(monkeypatch):
+  # the lax.scan entry point: the walk kernel sits inside the batch
+  # scan body; each step's table is kernel-local scratch, so
+  # iterations are independent by construction
+  monkeypatch.setenv('GLT_FUSED_WALK', 'cross')
+  g = _graph(seed=7)
+  fanouts = (3, 2)
+  seeds = jnp.asarray(
+      np.random.default_rng(0).integers(0, g['n'], (3, 6)).astype(
+          np.int32))
+  nv = jnp.full((3,), 6, jnp.int32)
+  key = jax.random.key(4)
+  plan = _plan(g, fanouts, 6)
+  table, scratch = make_dedup_tables(g['n'])
+  outs, _, _ = multihop_sample_many(None, seeds, nv, fanouts, key,
+                                    table, scratch, fused_plan=plan)
+  k = key
+  for t in range(3):
+    k, sub = jax.random.split(k)
+    one, _, _ = multihop_sample(None, seeds[t], nv[t], fanouts, sub,
+                                table, scratch, fused_plan=plan)
+    np.testing.assert_array_equal(np.asarray(outs['node'])[t],
+                                  np.asarray(one['node']))
+    np.testing.assert_array_equal(np.asarray(outs['row'])[t],
+                                  np.asarray(one['row']))
+
+
+def test_walk_fused_gather_and_bf16_plane(monkeypatch):
+  # in-walk gather through the cross-hop walk == post-hoc
+  # gather_features on every lane; the opt-in bf16 plane narrows the
+  # emitted block (values == reference cast) without touching the
+  # default path
+  from glt_tpu.data.feature import gather_features
+  from glt_tpu.sampler import NeighborSampler
+  monkeypatch.setenv('GLT_HOP_ENGINE', 'pallas_fused')
+  monkeypatch.setenv('GLT_FUSED_WALK', 'cross')
+  monkeypatch.setenv('GLT_WINDOW_W', '8')
+  ds = ring_dataset(num_nodes=40)
+  feat = ds.get_node_feature()
+  samp = NeighborSampler(ds.get_graph(), [3, 2], seed=0,
+                         fused_feature=feat)
+  out = samp.sample_from_nodes(np.arange(8))
+  fused_x = out.metadata['node_feats']
+  ref_x = gather_features(feat, out.node)
+  np.testing.assert_array_equal(np.asarray(ref_x), np.asarray(fused_x))
+
+  monkeypatch.setenv('GLT_FUSED_FEAT_DTYPE', 'bfloat16')
+  samp16 = NeighborSampler(ds.get_graph(), [3, 2], seed=0,
+                           fused_feature=feat)
+  out16 = samp16.sample_from_nodes(np.arange(8))
+  x16 = out16.metadata['node_feats']
+  assert x16.dtype == jnp.bfloat16
+  np.testing.assert_array_equal(
+      np.asarray(ref_x.astype(jnp.bfloat16), dtype=np.float32),
+      np.asarray(x16, dtype=np.float32))
+
+
+def test_walk_stream_zero_recompile_across_refresh_and_swap(
+    monkeypatch):
+  # the scan-carried walk forced on the stream path: overlay hops
+  # demote to pallas (counted once) and the zero-steady-state-
+  # recompile contract holds across overlay refreshes AND snapshot
+  # swaps, mirroring tests/test_stream.py
+  from glt_tpu.obs import MetricsRegistry, get_registry, set_registry
+  from glt_tpu.stream import (EdgeDeltaBuffer, SnapshotManager,
+                              StreamSampler)
+  prev = set_registry(MetricsRegistry())
+  try:
+    N = 24
+    ds = ring_dataset(num_nodes=N)
+    mgr = SnapshotManager(ds.get_graph().topo, ds.get_node_feature(),
+                          delta_capacity=64)
+    seeds = np.arange(6)
+    monkeypatch.setenv('GLT_DEDUP', 'sort')
+    monkeypatch.setenv('GLT_HOP_ENGINE', 'pallas_fused')
+    monkeypatch.setenv('GLT_FUSED_WALK', 'cross')
+    monkeypatch.setenv('GLT_WINDOW_W', '8')
+    samp = StreamSampler(mgr, [3, 2], seed=0)
+    samp.sample_from_nodes(seeds)
+    buf = EdgeDeltaBuffer(capacity=16, num_nodes=N)
+    buf.insert_edges([1, 2], [5, 6])
+    samp.refresh_overlay(buf)
+    traces, fns = samp.trace_count, samp.num_compiled_fns
+    for _ in range(3):
+      samp.sample_from_nodes(seeds)
+    mgr.compact(buf.drain())        # swap: same static shapes
+    samp.clear_overlay()
+    samp.sample_from_nodes(seeds)
+    assert samp.trace_count == traces
+    assert samp.num_compiled_fns == fns
+    assert get_registry().get('hop_engine_fallbacks_total',
+                              requested='pallas_fused',
+                              resolved='pallas',
+                              reason='stream_overlay') == 1.0
+  finally:
+    set_registry(prev)
+
+
+def test_walk_launch_collapse_and_table_gauges(monkeypatch):
+  # the O(hops)->O(1) launch collapse is an assertable number: the
+  # per-hop program traces hops+1 kernel entries (seed insert + one
+  # per hop), the walk exactly one; the fused-table geometry gauges
+  # land in the registry at plan build and occupancy under the opt-in
+  from glt_tpu.obs import MetricsRegistry, get_registry, set_registry
+  from glt_tpu.ops.pallas_kernels import kernel_launch_count
+  from glt_tpu.sampler import NeighborSampler
+  prev = set_registry(MetricsRegistry())
+  try:
+    g = _graph(seed=4)
+    seeds = jnp.asarray(np.arange(8, dtype=np.int32))
+    nv = jnp.asarray(8)
+    fanouts = (3, 2)
+    table, scratch = make_dedup_tables(g['n'])
+
+    def count_traced_launches(walk_mode):
+      monkeypatch.setenv('GLT_FUSED_WALK', walk_mode)
+      plan = _plan(g, fanouts, 8)
+
+      def f(s, k):
+        out, _, _ = multihop_sample(None, s, nv, fanouts, k, table,
+                                    scratch, fused_plan=plan)
+        return out['node_count']
+
+      # the counter bumps per NEW trace of a kernel wrapper — an inner
+      # jit-cache hit (same kernel, same shapes, earlier test) would
+      # silently undercount, so count against a cold cache
+      jax.clear_caches()
+      before = kernel_launch_count()
+      jax.jit(f).lower(seeds, jax.random.key(0))
+      return kernel_launch_count() - before
+
+    assert count_traced_launches('per_hop') == len(fanouts) + 1
+    assert count_traced_launches('cross') == 1
+
+    monkeypatch.setenv('GLT_HOP_ENGINE', 'pallas_fused')
+    monkeypatch.setenv('GLT_WINDOW_W', '8')
+    monkeypatch.setenv('GLT_OBS_TABLE_OCCUPANCY', '1')
+    ds = ring_dataset(num_nodes=40)
+    samp = NeighborSampler(ds.get_graph(), [3, 2], seed=0)
+    out = samp.sample_from_nodes(np.arange(8))
+    reg = get_registry()
+    slots = reg.get('fused_table_slots')
+    assert slots > 0
+    assert reg.get('fused_table_vmem_bytes') == 2 * slots * 4
+    assert reg.get('fused_table_occupancy_hwm') == float(
+        int(out.node_count))
+    assert 0 < reg.get('fused_table_occupancy_ratio_hwm') <= 1.0
+  finally:
+    set_registry(prev)
+
+
+def test_walk_demotes_to_per_hop_for_slot_eids(monkeypatch):
+  # with_edge over a graph WITHOUT an edge-id plane: the eids contract
+  # is raw CSR slots, which the walk never materializes — the fused
+  # path must quietly stay per-hop and keep the slot contract
+  monkeypatch.setenv('GLT_FUSED_WALK', 'cross')
+  g = _graph(seed=6)
+  seeds = jnp.asarray(np.arange(6, dtype=np.int32))
+  nv = jnp.asarray(6)
+  key = jax.random.key(3)
+  fanouts = (3,)
+  plan = FusedHopPlan(
+      g['indptr'], g['indices'], g['iw'], W, g['n_hub'],
+      fused_table_slots(sample_budget(6, list(fanouts))),
+      interpret=True)  # no edge_ids plane
+  table, scratch = make_dedup_tables(g['n'])
+  got, _, _ = multihop_sample(None, seeds, nv, fanouts, key, table,
+                              scratch, with_edge=True,
+                              fused_plan=plan)
+  ref = _ref_sort_fused(g, seeds, nv, fanouts, key, monkeypatch,
+                        with_edge=False)
+  for k in EXACT_KEYS:
+    np.testing.assert_array_equal(ref[k], np.asarray(got[k]),
+                                  err_msg=k)
+  assert 'edge' in got  # slot-contract eids still emitted
+
+
+def test_fused_walk_mode_knob(monkeypatch):
+  from glt_tpu.ops.pipeline import fused_walk_mode
+  monkeypatch.delenv('GLT_FUSED_WALK', raising=False)
+  # auto resolves per interpret-default: per_hop on the CPU suite
+  assert fused_walk_mode() == 'per_hop'
+  monkeypatch.setenv('GLT_FUSED_WALK', 'cross')
+  assert fused_walk_mode() == 'cross'
+  monkeypatch.setenv('GLT_FUSED_WALK', 'sideways')
+  with pytest.raises(ValueError):
+    fused_walk_mode()
+
+
 def test_hop_engine_knob_accepts_pallas_fused(monkeypatch):
   from glt_tpu.ops.pipeline import dedup_engine, hop_engine
   monkeypatch.setenv('GLT_HOP_ENGINE', 'pallas_fused')
