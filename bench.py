@@ -461,6 +461,25 @@ def run_worker():
     else:
       fused_walk_duel = {'skipped': 'bench budget exhausted'}
 
+  # Hetero multi-edge-type race (ISSUE 14 acceptance cells): sorted
+  # per-edge-type reference vs the fused multi-edge-type engine,
+  # per-batch vs superstep — seeds/s, the dispatches_per_step collapse
+  # and per-dispatch cost cells, keyed under their own history bench so
+  # hetero numbers never pollute homo baselines. Budget-guarded; a
+  # skip is recorded so CI can tell "didn't fit" from "broke".
+  hetero = None
+  if os.environ.get('GLT_BENCH_HETERO', '1') != '0':
+    spent = time.time() - t_start
+    from glt_tpu.ops.pallas_kernels import interpret_default
+    het_cost = 300 if interpret_default() else 120
+    if not worker_budget or worker_budget - spent > het_cost:
+      try:
+        hetero = measure_hetero_race()
+      except Exception as e:  # never fatal to the headline
+        hetero = {'error': str(e)[:200]}
+    else:
+      hetero = {'skipped': 'bench budget exhausted'}
+
   # Per-stage time breakdown (the obs layer): run a short instrumented
   # sample->gather epoch with tracing + full device-sync sampling, then
   # report each stage's share next to the headline. Fixed smoke-scale
@@ -535,7 +554,8 @@ def run_worker():
                   if isinstance(winner, dict) else None),
         train_steps_per_sec=train_ab,
         stage_breakdown=stage_breakdown,
-        fused_walk_duel=fused_walk_duel)
+        fused_walk_duel=fused_walk_duel,
+        hetero=hetero)
 
 
 def measure_stage_breakdown(batches: int = 8, num_nodes: int = 100_000,
@@ -794,6 +814,221 @@ def measure_fused_walk_duel(num_nodes: int = 20_000,
         'per_hop': ph['kernel_launches_per_dispatch'],
         'cross': cr['kernel_launches_per_dispatch']}
   return duel, entries
+
+
+def measure_hetero_race(iters: int = 3, supersteps: int = 4):
+  """Hetero multi-edge-type sampling race (ISSUE 14 acceptance cells):
+  the per-edge-type sorted reference vs the fused multi-edge-type
+  kernel engine, per-batch vs superstep, at a fixed smoke protocol on
+  WHATEVER backend the bench runs (interpret off-TPU, compiled Mosaic
+  on TPU — the driver's TPU round produces the decisive seeds/s
+  against the 174 seeds/s VERDICT baseline).
+
+  Records per contender: seeds/s, compile_s, steady_recompiles,
+  dispatches_per_step (1.0 per-batch; 1/K for the superstep — the
+  recorded DISPATCH COLLAPSE), and — when cost analysis is available —
+  bytes/FLOPs/kernel launches per dispatch plus a roofline cell
+  (item='seed'). Keyed in benchmarks/history.py under its own
+  ``hetero_sampler`` bench + its own scale string, so hetero numbers
+  never enter a homo baseline window. Returns (hetero_dict)."""
+  import functools
+  import numpy as np
+  import jax
+  import jax.numpy as jnp
+  from glt_tpu.data import Dataset
+  from glt_tpu.obs.perf import instrument_compiled
+  from glt_tpu.ops.pallas_kernels import interpret_default
+  from glt_tpu.ops.pipeline import (multihop_sample_hetero,
+                                    multihop_sample_hetero_many)
+  from glt_tpu.sampler import NeighborSampler
+  from glt_tpu.utils.rng import make_key
+
+  interp = interpret_default()
+  # interpret-mode fused tracing cost scales with the unrolled
+  # probe-insert loops, so the off-TPU smoke protocol stays toy-sized;
+  # every contender runs the SAME protocol, which is what the ratios
+  # need
+  nu = int(os.environ.get('GLT_BENCH_HET_USERS',
+                          '2000' if interp else '200000'))
+  ni = int(os.environ.get('GLT_BENCH_HET_ITEMS',
+                          '4000' if interp else '400000'))
+  batch = int(os.environ.get('GLT_BENCH_HET_BATCH',
+                             '32' if interp else '512'))
+  fan = [int(x) for x in os.environ.get(
+      'GLT_BENCH_HET_FANOUT', '3,2' if interp else '10,5').split(',')]
+  k_scan = max(int(os.environ.get('GLT_BENCH_HET_SCAN',
+                                  str(supersteps))), 2)
+  u2i = ('user', 'u2i', 'item')
+  i2i = ('item', 'i2i', 'item')
+  rng = np.random.default_rng(17)
+  u2i_ei = np.stack([np.repeat(np.arange(nu, dtype=np.int64), 4),
+                     rng.integers(0, ni, 4 * nu, dtype=np.int64)])
+  # skewed in-degrees so the per-type dedup namespaces see real load
+  i2i_src = np.repeat(np.arange(ni, dtype=np.int64), 4)
+  i2i_dst = ((rng.random(4 * ni) ** 2) * ni).astype(np.int64) % ni
+  ds = Dataset(edge_dir='out')
+  ds.init_graph(edge_index={u2i: u2i_ei,
+                            i2i: np.stack([i2i_src, i2i_dst])},
+                num_nodes={'user': nu, 'item': ni})
+  nn = {u2i: list(fan), i2i: list(fan)}
+  scale = (f'U{nu}_I{ni}_B{batch}_'
+           f'F{",".join(map(str, fan))}_K{k_scan}')
+
+  def _checksum(out):
+    acc = jnp.zeros((), jnp.float32)
+    for leaf in jax.tree_util.tree_leaves(out):
+      acc = acc + leaf.sum(dtype=jnp.float32)
+    return acc
+
+  seed_pool = rng.integers(0, nu, (iters + 2, k_scan, batch))
+  entries = {}
+  saved = {k: os.environ.get(k) for k in
+           ('GLT_HOP_ENGINE', 'GLT_FUSED_HOP', 'GLT_DEDUP',
+            'GLT_FUSED_WALK')}
+  try:
+    for label, env, use_plan, scan in (
+        ('hetero_sorted',
+         {'GLT_DEDUP': 'sort', 'GLT_FUSED_HOP': '1'}, False, 1),
+        ('hetero_pallas_fused',
+         {'GLT_HOP_ENGINE': 'pallas_fused'}, True, 1),
+        ('hetero_pallas_fused_superstep',
+         {'GLT_HOP_ENGINE': 'pallas_fused'}, True, k_scan)):
+      for k in saved:
+        os.environ.pop(k, None)
+      os.environ.update(env)
+      samp = NeighborSampler(ds.graph, nn, seed=0)
+      trav = samp._traversal_types()
+      caps, budgets = samp._hetero_caps({'user': batch})
+      plan = samp._hetero_fused_plan({'user': batch}) if use_plan \
+          else None
+      if use_plan and plan is None:
+        entries[label + '_skipped'] = (
+            'fused hetero plan unavailable (see '
+            'hop_engine_fallbacks_total)')
+        continue
+      one_hops = {e: (lambda ids, f, k, m, _e=e: samp._one_hop(
+          samp.graph[_e], ids, f, k, m)) for e in samp.edge_types}
+      tables = {t: samp._get_tables(t, n)
+                for t, n in samp._node_counts.items()}
+      traces = {'n': 0}
+
+      if scan > 1:
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def fn(seeds_stack, key, tables):
+          traces['n'] += 1  # trace-time side effect only
+          outs, tables = multihop_sample_hetero_many(
+              one_hops, trav, samp.num_neighbors, samp.num_hops,
+              caps, budgets, {'user': seeds_stack},
+              {'user': jnp.full((seeds_stack.shape[0],), batch,
+                                jnp.int32)},
+              key, tables, fused_plan=plan)
+          edges = sum(v.sum() for v in
+                      outs['num_sampled_edges'].values())
+          return edges, _checksum(outs), tables
+      else:
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def fn(seeds_stack, key, tables):
+          traces['n'] += 1  # trace-time side effect only
+          out, tables = multihop_sample_hetero(
+              one_hops, trav, samp.num_neighbors, samp.num_hops,
+              caps, budgets, {'user': seeds_stack[0]},
+              {'user': jnp.asarray(batch)}, key, tables,
+              fused_plan=plan)
+          edges = sum(v.sum() for v in
+                      out['num_sampled_edges'].values())
+          return edges, _checksum(out), tables
+
+      keys = jax.random.split(make_key(5), iters + 2)
+      arg_sds = (jax.ShapeDtypeStruct((k_scan, batch), jnp.int32),
+                 jax.ShapeDtypeStruct(keys[0].shape, keys[0].dtype),
+                 jax.tree_util.tree_map(
+                     lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                     tables))
+      t0 = time.time()
+      edges, sig, tables = fn(
+          jnp.asarray(seed_pool[0], jnp.int32), keys[0], tables)
+      jax.block_until_ready((edges, sig))
+      compile_s = time.time() - t0
+      edges, sig, tables = fn(
+          jnp.asarray(seed_pool[1], jnp.int32), keys[1], tables)
+      jax.block_until_ready((edges, sig))
+      traces_warm = traces['n']
+      counts, sigs = [], []
+      t1 = time.time()
+      for it in range(iters):
+        e_i, s_i, tables = fn(
+            jnp.asarray(seed_pool[it + 2], jnp.int32), keys[it + 2],
+            tables)
+        counts.append(e_i)
+        sigs.append(s_i)
+      jax.block_until_ready((counts[-1], sigs[-1]))
+      dt = time.time() - t1
+      steps = iters * scan  # batches consumed during the timed loop
+      total_edges = int(np.sum([int(c) for c in counts]))
+      rec = {
+          'seeds_per_sec': round(batch * steps / dt, 1),
+          'edges_per_sec': round(total_edges / dt, 1),
+          'compile_s': round(compile_s, 2),
+          'steady_recompiles': traces['n'] - traces_warm,
+          'dispatches_per_step': round(1.0 / scan, 4),
+          'seeds_per_dispatch': batch * scan,
+          'scale': scale,
+      }
+      if os.environ.get('GLT_BENCH_ROOFLINE', '1') != '0':
+        try:
+          cost = instrument_compiled(f'bench.hetero.{label}', fn,
+                                     *arg_sds, aot_compile=True)
+          if 'bytes_accessed' in cost:
+            rec['hbm_bytes_per_dispatch'] = cost['bytes_accessed']
+          if 'flops' in cost:
+            rec['flops_per_dispatch'] = cost['flops']
+          if 'kernel_launches' in cost:
+            rec['kernel_launches_per_dispatch'] = cost[
+                'kernel_launches']
+        except Exception as e:
+          print(f'# hetero cost analysis unavailable: {e}',
+                file=sys.stderr)
+      entries[label] = rec
+  finally:
+    for k, v in saved.items():
+      if v is None:
+        os.environ.pop(k, None)
+      else:
+        os.environ[k] = v
+
+  het = {'scale': scale, 'interpret': interp,
+         'baseline_seeds_per_sec_r5': 174.0,
+         'engines': entries}
+  pb = entries.get('hetero_pallas_fused', {})
+  ss = entries.get('hetero_pallas_fused_superstep', {})
+  if 'dispatches_per_step' in pb and 'dispatches_per_step' in ss:
+    het['dispatches_per_step'] = {
+        'per_batch': pb['dispatches_per_step'],
+        'superstep': ss['dispatches_per_step']}
+  if 'seeds_per_sec' in ss:
+    het['vs_r5_baseline'] = round(ss['seeds_per_sec'] / 174.0, 2)
+  # roofline cells: restate each contender's seeds/s against the
+  # measured device ceilings (same whole-cell rule as the homo race)
+  if os.environ.get('GLT_BENCH_ROOFLINE', '1') != '0':
+    try:
+      from glt_tpu.obs.perf import device_ceilings, roofline_report
+      import jax as _jax
+      ceilings = device_ceilings(_jax.devices()[0])
+      for rec in entries.values():
+        if not isinstance(rec, dict):
+          continue
+        spd = rec.get('seeds_per_dispatch') or 0
+        if (spd <= 0 or 'hbm_bytes_per_dispatch' not in rec
+            or 'flops_per_dispatch' not in rec):
+          continue
+        rec['roofline'] = roofline_report(
+            rec['seeds_per_sec'],
+            bytes_per_item=rec['hbm_bytes_per_dispatch'] / spd,
+            flops_per_item=rec['flops_per_dispatch'] / spd,
+            ceilings=ceilings, item='seed')
+    except Exception as e:
+      print(f'# hetero roofline unavailable: {e}', file=sys.stderr)
+  return het
 
 
 def _dump_obs_on_failure():

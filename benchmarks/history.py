@@ -141,6 +141,21 @@ def rows_from_bench_json(doc: dict, device: Optional[str] = None,
         rows.append({'bench': 'train_steps_per_sec', 'engine': eng,
                      'scale': scale, 'device': device,
                      'value': float(tab[eng]), 'unit': 'steps/s'})
+  het = doc.get('hetero')
+  if isinstance(het, dict) and 'error' not in het \
+      and 'skipped' not in het:
+    # hetero contenders live under their OWN bench name + their own
+    # scale string: a hetero seeds/s row must never enter a homo
+    # edges/s baseline window (run_key separates on both anyway; the
+    # distinct bench makes the series self-describing)
+    for label, rec in (het.get('engines') or {}).items():
+      if isinstance(rec, dict) and 'seeds_per_sec' in rec:
+        rows.append({'bench': 'hetero_sampler', 'engine': str(label),
+                     'scale': str(rec.get('scale',
+                                          het.get('scale', ''))),
+                     'device': device,
+                     'value': float(rec['seeds_per_sec']),
+                     'unit': 'seeds/s'})
   return rows
 
 

@@ -529,7 +529,13 @@ class DistHeteroTrainStep:
                                      NamedSharding(self.mesh, P()))
                    for t, v in labels.items()}
     self._optax = optax
+    #: times each program was TRACED (trace-time side effects;
+    #: executions never bump these) — the zero-steady-state-recompile
+    #: assertions on the hetero train path read them
+    self.step_traces = 0
+    self.superstep_traces = 0
     self._step_fn = self._build()
+    self._superstep_fn = None  # built lazily on first superstep call
     self._eval_fn = None  # built lazily on first eval_step call
 
   def _final_key(self, e):
@@ -724,6 +730,9 @@ class DistHeteroTrainStep:
     @functools.partial(jax.jit, donate_argnums=(9,))
     def step(params, opt_state, shards, feat_shards, efeat_shards,
              labels, seeds, n_valid, keys, tables):
+      self.step_traces += 1  # trace-time side effect only
+      from ..obs.perf import count_compile
+      count_compile('train.hetero_step')
       return fn(params, opt_state, shards, feat_shards, efeat_shards,
                 labels, seeds, n_valid, keys, tables)
 
@@ -733,6 +742,112 @@ class DistHeteroTrainStep:
                   self.labels, seeds, n_valid, keys, tables)
 
     return run
+
+  # -- superstep: K hetero batches per donated dispatch ------------------
+
+  def _build_superstep(self):
+    """The fused hetero superstep program (ISSUE 14 tentpole, first
+    move): lax.scan of the per-batch hetero body — per-edge-type
+    collective sampling + per-type feature all_to_all + RGNN
+    forward/backward + pmean'd update — with params/opt-state/per-type
+    dedup tables threaded through the carry
+    (ops/superstep.py::superstep_hetero). K batches then cost ONE
+    donated dispatch: the per-batch train loop's host round-trip, seed
+    transfer, and dispatch latency amortize 1/K — exactly the homo
+    superstep's collapse (parallel/train.py), now on the per-edge-type
+    dispatch train VERDICT round 5 measured at 174 seeds/s."""
+    optax = self._optax
+    model, tx, axis, bs = self.model, self.tx, self.axis, self.bs
+    device_batch, specs, payloads = self._assembly()
+    from ..ops.superstep import superstep_hetero
+
+    def device_superstep(params, opt_state, shards, feat_shards,
+                         efeat_shards, labels, seeds_stack,
+                         n_valid_stack, keys, tables):
+      def body(params, opt_state, tables, seeds, n_valid, key):
+        batch, y, out_tables = device_batch(
+            shards, feat_shards, efeat_shards, labels, seeds, n_valid,
+            key, tables)
+
+        def loss_fn(p):
+          logits = model.apply(p, batch)
+          mask = jnp.arange(bs) < n_valid[0]
+          l = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+          return jnp.where(mask, l, 0).sum() / jnp.maximum(mask.sum(),
+                                                           1)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = jax.lax.pmean(grads, axis)
+        loss = jax.lax.pmean(loss, axis)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, out_tables, loss[None]
+
+      run = superstep_hetero(body)
+      return run(params, opt_state, tables, seeds_stack, n_valid_stack,
+                 keys)
+
+    stacked = P(None, self.axis)
+    fn = jax.shard_map(
+        device_superstep, mesh=self.mesh,
+        in_specs=(P(), P(), specs['shards'], specs['feats'],
+                  specs['efeats'], specs['labels'], stacked, stacked,
+                  stacked, specs['tables']),
+        out_specs=(P(), P(), specs['tables'], stacked),
+        check_vma=False)
+
+    import functools
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 9))
+    def step(params, opt_state, shards, feat_shards, efeat_shards,
+             labels, seeds_stack, n_valid_stack, keys, tables):
+      self.superstep_traces += 1  # trace-time side effect only
+      from ..obs.perf import count_compile
+      count_compile('train.hetero_superstep')
+      return fn(params, opt_state, shards, feat_shards, efeat_shards,
+                labels, seeds_stack, n_valid_stack, keys, tables)
+
+    def run(params, opt_state, tables, seeds_stack, n_valid_stack,
+            keys):
+      shards, feat_shards, efeat_shards = payloads()
+      return step(params, opt_state, shards, feat_shards, efeat_shards,
+                  self.labels, seeds_stack, n_valid_stack, keys, tables)
+
+    return run
+
+  def superstep(self, params, opt_state, seeds_stack, n_valid_stack,
+                keys):
+    """Run T hetero training steps in ONE donated dispatch.
+
+    seeds_stack: [T, n_dev * bs] shard-major per batch; n_valid_stack:
+    [T, n_dev]; keys: [T, n_dev] PRNG keys (batch t on device d
+    consumes keys[t, d], exactly as T sequential ``__call__``\\ s
+    would). Params/opt-state are DONATED — reuse the returned ones.
+    Returns (params, opt_state, loss [T, n_dev]). Steady state is one
+    dispatch per T batches — ``dispatches_per_step`` drops from 1 to
+    1/T — with zero recompiles across calls of the same T
+    (``superstep_traces`` stays flat; a ragged epoch tail traces once
+    more by design, like the homo superstep)."""
+    if self._superstep_fn is None:
+      self._superstep_fn = self._build_superstep()
+    sh = NamedSharding(self.mesh, P(None, self.axis))
+    seeds = jax.device_put(
+        jnp.asarray(np.asarray(seeds_stack).reshape(
+            len(seeds_stack), -1), jnp.int32), sh)
+    nv = jax.device_put(jnp.asarray(n_valid_stack, jnp.int32), sh)
+    keys = jax.device_put(keys, sh)
+    from ..obs import get_registry, get_tracer
+    tracer = get_tracer()
+    _synced = {}
+    with tracer.span('train.hetero_superstep', k=int(seeds.shape[0]),
+                     sync=lambda: _synced.get('loss')):
+      (params, opt_state, self.sampler.tables,
+       loss) = self._superstep_fn(params, opt_state,
+                                  self.sampler.tables, seeds, nv, keys)
+      _synced['loss'] = loss
+    if tracer.enabled:
+      get_registry().set('train_hetero_superstep_traces',
+                         float(self.superstep_traces))
+    return params, opt_state, loss
 
   def __call__(self, params, opt_state, seeds, n_valid_per_device, key):
     n_dev = self.mesh.shape[self.axis]

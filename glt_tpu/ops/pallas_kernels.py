@@ -836,6 +836,110 @@ def sample_hop_dedup(arr_win: jax.Array,
 
 
 # ---------------------------------------------------------------------------
+# Hetero edge-type plane (ISSUE 14): the geometry that lets ONE
+# sample_hop_dedup invocation serve EVERY edge type of a hetero hop.
+#
+# The kernel itself is type-agnostic — it reads windows at `starts`,
+# picks at `offsets`, and dedups whatever int32 ids the windows hold.
+# The edge-type plane exploits that: each edge type's W-padded indices
+# block is concatenated into ONE flat array with its neighbor values
+# rebased into a GLOBAL node-id space (local id + type_base[ntype]), so
+#   * per-type window geometry is a per-row affine shift baked into
+#     `starts` (indptr_e[row] + edge_base[e]) — the same double-
+#     buffered HBM->VMEM window DMA serves every type;
+#   * per-type fanouts ride the [S, K_max] offset/validity planes
+#     (lanes past an edge type's fanout are invalid, never probed);
+#   * per-type dedup namespaces come FREE from the type-tagged keys:
+#     global ids never collide across types, so one VMEM table holds
+#     every type's seen-set and a probe is type-correct by construction.
+# The XLA epilogue (ops/pipeline.py::_multihop_sample_hetero_fused)
+# converts the kernel's global provisional labels back to the per-type
+# value-order label contract of the per-edge-type sorted reference.
+# ---------------------------------------------------------------------------
+
+
+def build_type_plane(etypes, trav, node_counts, parts, width):
+  """Build the flat multi-edge-type window geometry (eager, once per
+  compiled hetero program — plans are constructed outside jit).
+
+  Args:
+    etypes: traversal-order edge-type list (the reference hop loop's
+      iteration order; first-occurrence semantics depend on it).
+    trav: Dict[EdgeType, (expand_from_type, neighbor_type)].
+    node_counts: Dict[NodeType, int] — the per-type id spaces being
+      tagged into one global space.
+    parts: Dict[EdgeType, dict] with per-etype ``indices_win`` (the
+      W-padded indices, Graph.window_arrays contract), ``num_edges``,
+      and optional ``edge_ids_win``.
+    width: window width W (every block carries its own W-slot pad, so
+      any row's window read stays inside its block).
+
+  Returns dict(type_base, edge_base, indices_flat, eids_flat,
+  has_eids, total_nodes). Raises ValueError when the type-tagged key
+  space or the flat edge plane exceeds int32 — the genuinely
+  unservable hetero shapes (callers demote with reason ``hetero``).
+  """
+  types = list(node_counts)
+  type_base, base = {}, 0
+  for t in types:
+    type_base[t] = base
+    base += int(node_counts[t])
+  if base >= 2 ** 31:
+    raise ValueError(
+        f'{base} nodes across types exceed the int32 type-tagged key '
+        'space of the fused dedup table')
+  has_eids = {e: parts[e].get('edge_ids_win') is not None
+              for e in etypes}
+  any_eids = any(has_eids.values())
+  edge_base, off = {}, 0
+  blocks, eid_blocks = [], []
+  for e in etypes:
+    p = parts[e]
+    iw = jnp.asarray(p['indices_win'])
+    assert int(iw.shape[0]) == int(p['num_edges']) + int(width), (
+        'indices_win must carry exactly width trailing pad slots '
+        '(Graph.window_arrays contract)', e)
+    b = type_base[trav[e][1]]
+    # sentinel pad lanes stay -1 in the global space; valid lanes never
+    # read them (offsets < deg <= W stay inside the row's real window,
+    # hub rows are fixed by exact in-range slots)
+    blocks.append(jnp.where(iw >= 0, iw.astype(jnp.int32) + b,
+                            jnp.int32(-1)))
+    edge_base[e] = off
+    off += int(iw.shape[0])
+    if not any_eids:  # no zero-plane churn when no type carries eids
+      continue
+    ew = p.get('edge_ids_win')
+    if ew is None:
+      eid_blocks.append(jnp.zeros((int(iw.shape[0]),), jnp.int32))
+      continue
+    ew = jnp.asarray(ew)
+    if jnp.dtype(ew.dtype).itemsize > 4 and int(ew.shape[0]) \
+        and int(ew.max()) >= 2 ** 31:
+      # the flat eid plane is int32 (one common dtype across types);
+      # silently truncating 64-bit edge-id VALUES would diverge from
+      # the per-etype reference — fail the plan loudly instead (the
+      # sampler demotes with the counted `hetero` reason)
+      raise ValueError(
+          f'edge ids of {e} exceed the int32 range of the flat hetero '
+          'eid plane; remap edge ids below 2^31 per type or sample '
+          'this graph without the fused hetero engine')
+    eid_blocks.append(ew.astype(jnp.int32))
+  if off >= 2 ** 31:
+    raise ValueError(
+        f'{off} flat edge slots exceed the int32 window-start space')
+  return dict(
+      type_base=type_base,
+      edge_base=edge_base,
+      indices_flat=jnp.concatenate(blocks) if blocks
+      else jnp.zeros((0,), jnp.int32),
+      eids_flat=jnp.concatenate(eid_blocks) if any_eids else None,
+      has_eids=has_eids,
+      total_nodes=base,
+  )
+
+
+# ---------------------------------------------------------------------------
 # Cross-hop fused walk (ISSUE 13 tentpole): the WHOLE multi-hop walk as
 # one kernel invocation.
 #

@@ -760,7 +760,7 @@ def hetero_edge_hop_offsets(caps, trav, num_neighbors, num_hops):
 
 def multihop_sample_hetero(one_hops, trav, num_neighbors, num_hops,
                            caps, budgets, seeds, n_valid, key, tables,
-                           with_edge: bool = False):
+                           with_edge: bool = False, fused_plan=None):
   """Hetero hop loop shared by the single-device engine and the SPMD
   distributed engine (only the per-edge-type ``one_hops`` differ:
   in-HBM sampling vs the all_to_all collective version).
@@ -773,6 +773,13 @@ def multihop_sample_hetero(one_hops, trav, num_neighbors, num_hops,
       node type (callers compute them identically from trav).
     seeds/n_valid: Dict[NodeType, array] — multi-type seeding.
     tables: Dict[NodeType, (table, scratch)].
+    fused_plan: a :class:`glt_tpu.ops.sample.HeteroFusedPlan` — routes
+      every hop through ONE padded multi-edge-type ``sample_hop_dedup``
+      invocation (per-edge-type sampling batched over the flat
+      edge-type plane, per-type dedup namespaces via type-tagged keys)
+      instead of the per-etype ``one_hops`` + per-type sort dedup.
+      Label semantics identical to the per-edge-type sorted reference
+      with GLT_FUSED_HOP=1; ``tables`` pass through untouched.
 
   Returns (result dict, out_tables) with per-type node lists, per-etype
   row(parent)/col(child) label buffers in traversal orientation, batch
@@ -781,6 +788,11 @@ def multihop_sample_hetero(one_hops, trav, num_neighbors, num_hops,
   from ..obs.perf import count_compile
   count_compile('ops.multihop_sample_hetero')  # trace-time only
   from .unique import dense_assign, dense_init, dense_reset
+  if fused_plan is not None:
+    result = _multihop_sample_hetero_fused(
+        fused_plan, trav, num_neighbors, num_hops, caps, budgets,
+        seeds, n_valid, key, with_edge=with_edge)
+    return result, tables
   if dedup_engine() == 'sort':
     result = _multihop_sample_hetero_sorted(
         one_hops, trav, num_neighbors, num_hops, caps, budgets, seeds,
@@ -979,6 +991,285 @@ def _multihop_sample_hetero_sorted(one_hops, trav, num_neighbors,
   if with_edge:
     result['edge'] = {e: jnp.concatenate(v) for e, v in eid_d.items()}
   return result
+
+
+def _pad_cols(a, k_max):
+  """Pad a [S, k] plane to [S, k_max] lanes (zeros — padded lanes ride
+  an all-False validity plane, so the kernel never probes them)."""
+  k = a.shape[1]
+  return a if k == k_max else jnp.pad(a, ((0, 0), (0, k_max - k)))
+
+
+def _empty_frontier(c0):
+  """Placeholder frontier for a type with no live rows — identical to
+  the sorted reference's (zero ids, -1 labels, all-False mask)."""
+  return (jnp.zeros((c0,), jnp.int32), jnp.full((c0,), -1, jnp.int32),
+          jnp.zeros((c0,), bool))
+
+
+def _multihop_sample_hetero_fused(plan, trav, num_neighbors, num_hops,
+                                  caps, budgets, seeds, n_valid, key,
+                                  with_edge: bool = False):
+  """The hetero hop loop on the ``pallas_fused`` kernel family: each
+  hop's per-edge-type sampling runs as ONE padded multi-edge-type
+  ``sample_hop_dedup`` invocation over the flat edge-type plane.
+
+  Per hop: the XLA prologue draws offsets per edge type from the SAME
+  key sequence as the reference loop (bit-identical offsets by
+  construction), rebases each segment's window starts into the flat
+  plane, pads fanouts to the hop's K_max behind the validity lanes,
+  and concatenates the per-etype hub fix-ups. The kernel samples every
+  segment's windows through one double-buffered DMA pipeline and
+  probes/inserts the type-tagged picks into ONE VMEM-resident table —
+  global ids never collide across types, so the per-type dedup
+  namespaces come free. The XLA epilogue restores the exact per-type
+  ``sorted_hop_dedup_fused`` label contract (new ids labeled
+  ``count_t..count_t+n_t-1`` in within-hop VALUE order per type) with
+  one narrow [m_t] sort per (type, hop) and an incremental provisional
+  -> final remap ``R`` (the cross-hop walk's epilogue pattern), so the
+  kernel's global first-occurrence labels never leave this function.
+
+  Bit-identical to the per-edge-type sorted reference
+  (GLT_DEDUP=sort GLT_FUSED_HOP=1) on every output surface; masked-out
+  edge lanes are undefined per engine, as for every fused form
+  (asserted in interpret mode by tests/test_pallas_fused.py)."""
+  from .pallas_kernels import sample_hop_dedup
+  from .sample import _draw_hop, _hub_fixup_inputs, _slots_i32
+  big = jnp.iinfo(jnp.int32).max
+  types = list(budgets)
+  budget_total = int(plan.budget_total)
+
+  # -- exact multi-type seed hop (identical to the sorted reference) --
+  seen, seed_labels, frontier = {}, {}, {}
+  zero = jnp.zeros((0,), jnp.int32)
+  for t in types:
+    c0 = max(1, caps[0][t])
+    if t in seeds:
+      s = seeds[t]
+      mask = jnp.arange(s.shape[0]) < n_valid[t]
+      d = sorted_hop_dedup(zero, zero, jnp.zeros((), jnp.int32), s,
+                           mask)
+      sl = jax.lax.sort([d['pos3'], d['labels3']], num_keys=1)[1]
+      seed_labels[t] = jnp.where(mask, sl, -1)
+      seen[t] = (d['u_ids2'], d['u_labs2'], d['count2'])
+      frontier[t] = (d['ids3'], d['labels3'], d['new_head3'])
+    else:
+      seen[t] = (zero, zero, jnp.zeros((), jnp.int32))
+      frontier[t] = _empty_frontier(c0)
+
+  # provisional-global label space: type t's seed uniques take the
+  # range [gbase_t, gbase_t + count_t) (gbase = running total in type
+  # order); R maps provisional-global -> final per-type labels.
+  count = {t: seen[t][2] for t in types}
+  gcount = jnp.zeros((), jnp.int32)
+  remap = jnp.zeros((budget_total + 1,), jnp.int32)
+  ins_ids, ins_labs, ins_ok = [], [], []
+  for t in types:
+    if t not in seeds:
+      continue
+    ids3, labels3, nh3 = frontier[t]
+    gid = jnp.where(nh3, ids3.astype(jnp.int32) + plan.type_base[t],
+                    -1)
+    gprov = jnp.where(nh3, gcount + labels3, 0)
+    ins_ids.append(gid)
+    ins_labs.append(gprov)
+    ins_ok.append(nh3.astype(jnp.int32))
+    remap = remap.at[jnp.where(nh3, gcount + labels3,
+                               budget_total)].set(
+        jnp.where(nh3, labels3, remap[budget_total]))
+    gcount = gcount + count[t]
+  table = plan.init_table(
+      jnp.concatenate(ins_ids) if ins_ids else zero,
+      jnp.concatenate(ins_labs) if ins_labs else zero,
+      jnp.concatenate(ins_ok) if ins_ok else zero)
+
+  rows_d, cols_d, mask_d, eid_d = {}, {}, {}, {}
+  hop_nodes = {t: [count[t]] for t in types}
+  hop_edges = {}
+  for h in range(num_hops):
+    # -- XLA prologue: per-etype draws (reference key sequence) -------
+    segs = []
+    for e, (row_t, col_t) in trav.items():
+      k = num_neighbors[e][h]
+      if caps[h][row_t] == 0 or k == 0:
+        continue
+      f_ids, f_labels, f_mask = frontier[row_t]
+      key, sub = jax.random.split(key)
+      sg = dict(e=e, row_t=row_t, col_t=col_t, k=k, s=f_ids.shape[0],
+                f_labels=f_labels, empty=plan.num_edges[e] == 0)
+      if not sg['empty']:
+        indptr = plan.indptr[e]
+        start, deg, offsets, mask = _draw_hop(
+            indptr, f_ids.astype(indptr.dtype), k, sub, f_mask,
+            plan.replace)
+        sg.update(start=start, deg=deg, offsets=offsets, mask=mask,
+                  slots=_slots_i32(start, offsets, plan.num_edges[e]))
+      segs.append(sg)
+
+    if segs:
+      k_max = max(sg['k'] for sg in segs)
+      starts_c, offs_c, valid_c, hub_idx_c, hub_slots_c = \
+          [], [], [], [], []
+      row_off = 0
+      for sg in segs:
+        sg['row_off'] = row_off
+        s_e, k = sg['s'], sg['k']
+        if sg['empty']:
+          starts_c.append(jnp.zeros((s_e,), jnp.int32))
+          offs_c.append(jnp.zeros((s_e, k_max), jnp.int32))
+          valid_c.append(jnp.zeros((s_e, k_max), jnp.int32))
+        else:
+          eb = plan.edge_base[sg['e']]
+          starts_c.append((sg['start'].astype(jnp.int32) + eb))
+          offs_c.append(_pad_cols(sg['offsets'], k_max))
+          valid_c.append(_pad_cols(sg['mask'].astype(jnp.int32),
+                                   k_max))
+          h_e = min(plan.hub_count[sg['e']], s_e)
+          hub_idx, hub_slots = _hub_fixup_inputs(
+              sg['deg'], sg['slots'] + eb, plan.width, h_e, k, s_e)
+          hub_idx_c.append(jnp.where(hub_idx >= 0,
+                                     hub_idx + row_off, -1))
+          hub_slots_c.append(_pad_cols(hub_slots, k_max))
+        row_off += s_e
+      if not hub_idx_c:  # static dummy row: -1 never matches a block
+        hub_idx_c = [jnp.full((1,), -1, jnp.int32)]
+        hub_slots_c = [jnp.zeros((1, k_max), jnp.int32)]
+      tab_ids, tab_labs = table
+      with jax.named_scope(f'sample_dedup_hetero_fused{h}'):
+        picks, eidp, prov, newh, tab_ids, tab_labs = sample_hop_dedup(
+            plan.indices_flat,
+            plan.eids_flat if (with_edge and plan.eids_flat is not None)
+            else None,
+            jnp.concatenate(starts_c), jnp.concatenate(offs_c),
+            jnp.concatenate(valid_c), jnp.concatenate(hub_idx_c),
+            jnp.concatenate(hub_slots_c), tab_ids, tab_labs, gcount,
+            width=plan.width, interpret=plan.interpret)
+      table = (tab_ids, tab_labs)
+      for sg in segs:
+        r0, s_e, k = sg['row_off'], sg['s'], sg['k']
+        sg['picks'] = jax.lax.slice(
+            picks, (r0, 0), (r0 + s_e, k)).reshape(-1)
+        sg['prov'] = jax.lax.slice(
+            prov, (r0, 0), (r0 + s_e, k)).reshape(-1)
+        sg['nh'] = jax.lax.slice(
+            newh, (r0, 0), (r0 + s_e, k)).reshape(-1) != 0
+        if with_edge and eidp is not None:
+          sg['eidp'] = jax.lax.slice(
+              eidp, (r0, 0), (r0 + s_e, k)).reshape(-1)
+        sg['mask_flat'] = (jnp.zeros((s_e * k,), bool) if sg['empty']
+                          else sg['mask'].reshape(-1))
+
+    # -- XLA epilogue: per-type value-order relabel through R ---------
+    labels_by_type = {}
+    new_this_hop = jnp.zeros((), jnp.int32)
+    for t in types:
+      tsegs = [sg for sg in segs if sg['col_t'] == t]
+      if not tsegs:
+        frontier[t] = _empty_frontier(max(1, caps[h + 1][t]))
+        hop_nodes[t].append(jnp.zeros((), jnp.int32))
+        continue
+      ids_t = jnp.concatenate([sg['picks'].astype(jnp.int32)
+                               for sg in tsegs])
+      prov_t = jnp.concatenate([sg['prov'] for sg in tsegs])
+      nh_t = jnp.concatenate([sg['nh'] for sg in tsegs])
+      mask_t = jnp.concatenate([sg['mask_flat'] for sg in tsegs])
+      m_t = ids_t.shape[0]
+      # one narrow 2-operand sort ranks this hop's fresh type-t ids by
+      # VALUE (global order == local order: the type base is a shared
+      # additive constant) — the sorted_hop_dedup_fused contract
+      keyv = jnp.where(nh_t, ids_t, big)
+      iota = jnp.arange(m_t, dtype=jnp.int32)
+      sorted_ids, sorted_pos = jax.lax.sort([keyv, iota], num_keys=1)
+      rank_slot = jnp.zeros((m_t + 1,), jnp.int32).at[
+          jnp.where(sorted_ids < big, sorted_pos, m_t)].set(iota)[:m_t]
+      final_t = count[t] + rank_slot
+      remap = remap.at[jnp.where(nh_t, prov_t, budget_total)].set(
+          jnp.where(nh_t, final_t, remap[budget_total]))
+      labels3_t = jnp.where(
+          mask_t, jnp.take(remap, jnp.clip(prov_t, 0, budget_total)),
+          -1)
+      labels_by_type[t] = labels3_t
+      new_t = nh_t.sum(dtype=jnp.int32)
+      local_ids = ids_t - plan.type_base[t]
+      u_ids_t, u_labs_t, _ = seen[t]
+      seen[t] = (
+          jnp.concatenate([u_ids_t, jnp.where(nh_t, local_ids, big)]),
+          jnp.concatenate([u_labs_t, jnp.where(nh_t, labels3_t, big)]),
+          count[t] + new_t)
+      frontier[t] = (jnp.where(nh_t, local_ids, big), labels3_t, nh_t)
+      hop_nodes[t].append(new_t)
+      count[t] = count[t] + new_t
+      new_this_hop = new_this_hop + new_t
+    gcount = gcount + new_this_hop
+
+    # -- per-etype edge buffers, cursor-sliced in traversal order -----
+    cursor = {t: 0 for t in types}
+    for sg in segs:
+      e, col_t, k = sg['e'], sg['col_t'], sg['k']
+      w_e = sg['s'] * k
+      c0 = cursor[col_t]
+      cursor[col_t] += w_e
+      lab = jax.lax.slice(labels_by_type[col_t], (c0,), (c0 + w_e,))
+      rows_d.setdefault(e, []).append(jnp.repeat(sg['f_labels'], k))
+      cols_d.setdefault(e, []).append(
+          jnp.where(sg['mask_flat'], lab, -1))
+      mask_d.setdefault(e, []).append(sg['mask_flat'])
+      if with_edge:
+        if sg['empty']:
+          eid = jnp.full((w_e,), -1, jnp.int32)
+        elif plan.has_eids[e]:
+          eid = sg['eidp']
+        else:  # no edge-id plane for this type: slot contract (local)
+          eid = sg['slots'].reshape(-1)
+        eid_d.setdefault(e, []).append(eid)
+      hop_edges.setdefault(e, []).append(
+          sg['mask_flat'].sum().astype(jnp.int32))
+
+  nodes = {t: sorted_nodes_by_label(*seen[t], budgets[t])
+           for t in types}
+  result = dict(
+      node=nodes,
+      node_count={t: seen[t][2] for t in types},
+      row={e: jnp.concatenate(v) for e, v in rows_d.items()},
+      col={e: jnp.concatenate(v) for e, v in cols_d.items()},
+      edge_mask={e: jnp.concatenate(v) for e, v in mask_d.items()},
+      batch={t: jax.lax.slice(nodes[t], (0,), (seeds[t].shape[0],))
+             for t in seeds},
+      seed_labels=seed_labels,
+      num_sampled_nodes={t: jnp.stack(v) for t, v in hop_nodes.items()},
+      num_sampled_edges={e: jnp.stack(v) for e, v in hop_edges.items()},
+  )
+  if with_edge:
+    result['edge'] = {e: jnp.concatenate(v) for e, v in eid_d.items()}
+  return result
+
+
+def multihop_sample_hetero_many(one_hops, trav, num_neighbors,
+                                num_hops, caps, budgets, seeds_stack,
+                                n_valid_stack, key, tables,
+                                with_edge: bool = False,
+                                fused_plan=None):
+  """T hetero sampling batches in ONE dispatch via lax.scan — the
+  hetero counterpart of :func:`multihop_sample_many` (the sampling
+  half of the hetero superstep; ops/superstep.py scans the full train
+  body the same way). ``seeds_stack``: Dict[NodeType, [T, B_t]];
+  ``n_valid_stack``: Dict[NodeType, [T]]. Iterations are independent
+  (the fused path builds a fresh VMEM table per step; the table path's
+  per-batch reset contract carries over), so results are identical to
+  T separate :func:`multihop_sample_hetero` calls on the same key
+  stream."""
+  def step(carry, inp):
+    tabs, k = carry
+    seeds, n_valid = inp
+    k, sub = jax.random.split(k)
+    out, tabs = multihop_sample_hetero(
+        one_hops, trav, num_neighbors, num_hops, caps, budgets, seeds,
+        n_valid, sub, tabs, with_edge=with_edge, fused_plan=fused_plan)
+    return (tabs, k), out
+
+  (tables, _), outs = jax.lax.scan(step, (tables, key),
+                                   (seeds_stack, n_valid_stack))
+  return outs, tables
 
 
 def multihop_sample_many(one_hop: OneHopFn,

@@ -531,6 +531,68 @@ class FusedHopPlan:
     return out, d, table
 
 
+class HeteroFusedPlan:
+  """Trace-time bundle for the ``pallas_fused`` engine over a HETERO
+  graph: the flat multi-edge-type window geometry (the kernel family's
+  edge-type plane, :func:`glt_tpu.ops.pallas_kernels.build_type_plane`)
+  plus per-etype CSR handles and static hub/table sizing. Built once
+  per compiled hetero multihop program (sampler/neighbor_sampler.py,
+  bench.py) and consumed by
+  :func:`glt_tpu.ops.pipeline.multihop_sample_hetero` — the plan routes
+  each hop's per-edge-type sampling into ONE padded multi-edge-type
+  ``sample_hop_dedup`` invocation: one concatenated frontier whose
+  per-segment ``starts`` address the flat plane, per-type fanouts as
+  [S, K_max] offset/validity lanes, and per-type dedup namespaces via
+  the type-tagged global id space.
+
+  Args:
+    etypes: traversal-order edge types (= the reference hop loop's
+      iteration order).
+    trav: Dict[EdgeType, (expand_from_type, neighbor_type)].
+    node_counts: Dict[NodeType, int].
+    parts: Dict[EdgeType, dict(indptr, indices_win, num_edges,
+      hub_count, edge_ids_win=None)] — ``indices_win`` per the
+      Graph.window_arrays contract (W trailing pad slots).
+    width: window width W (shared across edge types).
+    table_slots: VMEM dedup-table capacity in id slots; must exceed the
+      walk's TOTAL node budget across types (probe termination).
+    budget_total: sum of per-type node budgets — sizes the provisional
+      label remap of the XLA epilogue.
+  """
+
+  def __init__(self, etypes, trav, node_counts, parts, width,
+               table_slots, budget_total, replace=False,
+               interpret=False):
+    from .pallas_kernels import build_type_plane
+    self.etypes = list(etypes)
+    self.trav = dict(trav)
+    self.width = int(width)
+    self.table_slots = int(table_slots)
+    self.budget_total = int(budget_total)
+    self.replace = bool(replace)
+    self.interpret = bool(interpret)
+    self.indptr = {e: parts[e]['indptr'] for e in self.etypes}
+    self.num_edges = {e: int(parts[e]['num_edges'])
+                      for e in self.etypes}
+    self.hub_count = {e: int(parts[e].get('hub_count', 0))
+                      for e in self.etypes}
+    plane = build_type_plane(self.etypes, self.trav, node_counts,
+                             parts, self.width)
+    self.type_base = plane['type_base']
+    self.edge_base = plane['edge_base']
+    self.indices_flat = plane['indices_flat']
+    self.eids_flat = plane['eids_flat']
+    self.has_eids = plane['has_eids']
+
+  def init_table(self, ids, labs, valid):
+    """Fresh table planes seeded with the exact-dedup'd multi-type seed
+    hop (ids already type-tagged, labels provisional-global)."""
+    from .pallas_kernels import dedup_table_insert, make_dedup_table
+    tab_ids, tab_labs = make_dedup_table(self.table_slots)
+    return dedup_table_insert(tab_ids, tab_labs, ids, labs, valid,
+                              interpret=self.interpret)
+
+
 def sample_full_neighbors(
     indptr: jax.Array,
     indices: jax.Array,

@@ -47,19 +47,51 @@ def superstep(batch_step: BatchStepFn, unroll: int = 1):
   stacked inputs must agree; each scan iteration consumes one slice.
   """
 
+  def body(params, opt_state, state, seeds, n_valid, key):
+    table, scratch = state
+    params, opt_state, table, scratch, aux = batch_step(
+        params, opt_state, table, scratch, seeds, n_valid, key)
+    return params, opt_state, (table, scratch), aux
+
+  # the homo (table, scratch) pair is a special case of the pytree-
+  # state lift below — ONE scan implementation serves both engines
+  run_tree = superstep_hetero(body, unroll)
+
   def run(params, opt_state, table, scratch, seeds_stack, n_valid_stack,
           keys):
-    def step(carry, x):
-      params, opt_state, table, scratch = carry
-      seeds, n_valid, key = x
-      params, opt_state, table, scratch, aux = batch_step(
-          params, opt_state, table, scratch, seeds, n_valid, key)
-      return (params, opt_state, table, scratch), aux
-
-    (params, opt_state, table, scratch), aux = jax.lax.scan(
-        step, (params, opt_state, table, scratch),
-        (seeds_stack, n_valid_stack, keys), unroll=unroll)
+    params, opt_state, (table, scratch), aux = run_tree(
+        params, opt_state, (table, scratch), seeds_stack,
+        n_valid_stack, keys)
     return params, opt_state, table, scratch, aux
+
+  return run
+
+
+def superstep_hetero(batch_step: Callable, unroll: int = 1):
+  """Hetero variant of :func:`superstep`: the dedup state is one opaque
+  pytree (the hetero engine's per-type table dict — or, on the fused
+  hetero engine, pass-through placeholders) instead of the homo
+  ``(table, scratch)`` pair. Everything else is the same lax.scan
+  lift: K hetero training batches (per-edge-type collective sampling +
+  per-type feature exchange + RGNN update) run as ONE donated dispatch,
+  bit-identical to K sequential per-batch calls on the same key stream.
+
+  ``batch_step(params, opt_state, tables, seeds, n_valid, key) ->
+  (params, opt_state, tables, aux)``; seeds/n_valid/keys carry a
+  leading [T] axis (per-type seed dicts stack per leaf)."""
+
+  def run(params, opt_state, tables, seeds_stack, n_valid_stack, keys):
+    def step(carry, x):
+      params, opt_state, tables = carry
+      seeds, n_valid, key = x
+      params, opt_state, tables, aux = batch_step(
+          params, opt_state, tables, seeds, n_valid, key)
+      return (params, opt_state, tables), aux
+
+    (params, opt_state, tables), aux = jax.lax.scan(
+        step, (params, opt_state, tables),
+        (seeds_stack, n_valid_stack, keys), unroll=unroll)
+    return params, opt_state, tables, aux
 
   return run
 

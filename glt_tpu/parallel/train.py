@@ -17,7 +17,12 @@ Two execution modes share one batch body:
     dispatch via lax.scan (ops/superstep.py), consuming seed stacks the
     DeviceEpochLoader staged on device once per epoch. Bit-identical to
     K sequential per-batch calls (same RNG stream, same op sequence) —
-    the scan only amortizes the per-batch host round-trips.
+    the scan only amortizes the per-batch host round-trips. The hetero
+    sibling — per-edge-type collective sampling + RGNN update, same
+    scan lift with the per-type table dict as the dedup state — is
+    ``glt_tpu.distributed.DistHeteroTrainStep.superstep``
+    (ops/superstep.py::superstep_hetero, which this trainer's homo
+    ``(table, scratch)`` superstep is now a special case of).
 
 For host-spilled features WITHOUT the pinned-host cold block
 (``cold_array is None``) the fused body cannot resolve cold rows

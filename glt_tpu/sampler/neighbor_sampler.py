@@ -51,6 +51,14 @@ logger = logging.getLogger(__name__)
 DENSE_TABLE_NODE_LIMIT = 256_000_000
 
 
+def _window_width() -> int:
+  """Window width W of the windowed hop engines (`GLT_WINDOW_W`,
+  default 96, floored at 8) — ONE definition so the homo plan, the
+  hetero plan, and the demoted per-hop window read can never disagree
+  on the geometry they share."""
+  return max(int(os.environ.get('GLT_WINDOW_W', '96')), 8)
+
+
 class NeighborSampler(BaseSampler):
   """Uniform/weighted multi-hop neighbor sampling over device CSR/CSC.
 
@@ -232,21 +240,24 @@ class NeighborSampler(BaseSampler):
   def _resolved_hop_engine(self) -> str:
     """The engine this sampler ACTUALLY runs: ``pallas_fused`` demotes
     to ``pallas`` (counted, ``hop_engine_fallbacks_total``) for the hop
-    shapes the fusion does not serve — hetero traversals (per-edge-type
-    frontiers would each need their own resident table), weighted and
-    full-neighborhood hops (no uniform offset pick to fuse), and a
-    forced dense dedup engine (the fused kernel IS the sort-contract
-    inducer)."""
+    shapes the fusion does not serve — weighted and full-neighborhood
+    hops (no uniform offset pick to fuse) and a forced dense dedup
+    engine (the fused kernel IS the sort-contract inducer). Hetero
+    traversals are SERVED by the fused family (one padded
+    multi-edge-type invocation per hop over the edge-type plane,
+    :class:`~glt_tpu.ops.sample.HeteroFusedPlan`); the ``hetero``
+    fallback reason fires only for genuinely unservable hetero shapes
+    — a type-tagged key space past int32 (``_hetero_fused_plan``) —
+    never for hetero as such."""
     eng = getattr(self, '_hop_engine_override', None) or hop_engine()
     if eng != 'pallas_fused':
       return eng
-    if self.is_hetero:
-      self._count_fallback('hetero')
-      return 'pallas'
     if self.with_weight:
       self._count_fallback('weighted')
       return 'pallas'
-    if any(f < 0 for f in self.num_neighbors):
+    fanouts = (sum(self.num_neighbors.values(), []) if self.is_hetero
+               else self.num_neighbors)
+    if any(f < 0 for f in fanouts):
       self._count_fallback('full_neighborhood')
       return 'pallas'
     if os.environ.get('GLT_DEDUP') == 'table':
@@ -267,7 +278,7 @@ class NeighborSampler(BaseSampler):
                                       interpret_default)
     from ..ops.sample import FusedHopPlan
     g: Graph = self.graph
-    width = max(int(os.environ.get('GLT_WINDOW_W', '96')), 8)
+    width = _window_width()
     fields = ('indices', 'edge_ids') if (
         self.with_edge and g.topo.edge_ids is not None) else ('indices',)
     # window_arrays BEFORE touching g.indices/edge_ids — the padded
@@ -312,6 +323,60 @@ class NeighborSampler(BaseSampler):
         feat_dim=feat_dim, feat_dtype=feat_dtype,
         indptr_pad=g.indptr_pad())
 
+  def _hetero_fused_plan(self, batch_sizes: Dict[NodeType, int]):
+    """Build the :class:`~glt_tpu.ops.sample.HeteroFusedPlan` for one
+    compiled hetero multihop program, or None with a counted fallback
+    when the fused engine cannot engage at this shape. Fallback reasons
+    stay SPECIFIC — ``host_mode_arrays`` (no device window arrays),
+    ``table_overflow`` (total cross-type budget past the VMEM knob) —
+    and the bare ``hetero`` reason is reserved for the genuinely
+    unservable hetero shapes: a type-tagged global id space or flat
+    edge plane past int32 (build_type_plane raises)."""
+    if self._resolved_hop_engine() != 'pallas_fused':
+      return None
+    from ..ops.pallas_kernels import (fused_table_max_slots,
+                                      fused_table_slots,
+                                      interpret_default)
+    from ..ops.sample import HeteroFusedPlan
+    width = _window_width()
+    parts = {}
+    for e in self.edge_types:
+      g: Graph = self.graph[e]
+      fields = ('indices', 'edge_ids') if (
+          self.with_edge and g.topo.edge_ids is not None) \
+          else ('indices',)
+      # window_arrays BEFORE touching g.indptr — the padded copy
+      # supersedes the originals (one-resident-copy rule)
+      sources = g.window_arrays(width, fields)
+      if any(sources.get(f) is None for f in fields):
+        self._count_fallback('host_mode_arrays', resolved='element')
+        return None
+      parts[e] = dict(indptr=g.indptr, indices_win=sources['indices'],
+                      num_edges=g.num_edges,
+                      hub_count=g.hub_count(width),
+                      edge_ids_win=sources.get('edge_ids'))
+    caps, budgets = self._hetero_caps(batch_sizes)
+    budget_total = sum(budgets.values())
+    slots = fused_table_slots(budget_total)
+    # geometry gauges BEFORE the overflow gate (same rationale as homo)
+    self._publish_table_geometry(slots)
+    if slots > fused_table_max_slots():
+      self._count_fallback('table_overflow')
+      return None
+    try:
+      plan = HeteroFusedPlan(
+          self.edge_types, self._traversal_types(), self._node_counts,
+          parts, width, slots, budget_total, replace=self.replace,
+          interpret=interpret_default())
+    except ValueError as e:
+      # int32 type-tagged key space exceeded: the one hetero shape the
+      # fused family genuinely cannot serve
+      logger.warning('hetero fused plan unavailable: %s', e)
+      self._count_fallback('hetero')
+      return None
+    self._table_slots = slots
+    return plan
+
   def _publish_table_geometry(self, slots: int) -> None:
     """Registry gauges for the fused dedup table's static geometry —
     chosen slot count and VMEM bytes (both planes) — so a
@@ -351,7 +416,11 @@ class NeighborSampler(BaseSampler):
         if not (t.enabled and t._sample > 0
                 and random.random() < t._sample):
           return
-      occ = int(out['node_count'])
+      occ = out['node_count']
+      # hetero: the table is shared across types (type-tagged keys), so
+      # occupancy is the cross-type distinct total
+      occ = (sum(int(c) for c in occ.values())
+             if isinstance(occ, dict) else int(occ))
       hwm = max(getattr(self, '_table_occ_hwm', 0), occ)
       self._table_occ_hwm = hwm
       reg = get_registry()
@@ -378,7 +447,7 @@ class NeighborSampler(BaseSampler):
       eng = 'pallas'
     if eng == 'element':
       return {}
-    width = max(int(os.environ.get('GLT_WINDOW_W', '96')), 8)
+    width = _window_width()
     fields = ('indices', 'edge_ids') if (
         self.with_edge and g.topo.edge_ids is not None) else ('indices',)
     sources = g.window_arrays(width, fields)
@@ -536,6 +605,7 @@ class NeighborSampler(BaseSampler):
         e: (lambda ids, fanout, key, mask, _e=e: self._one_hop(
             self.graph[_e], ids, fanout, key, mask))
         for e in self.edge_types}
+    fused_plan = self._hetero_fused_plan(batch_sizes)
 
     def fn(seeds, n_valid, key, tables):
       from ..obs.perf import count_compile
@@ -543,7 +613,7 @@ class NeighborSampler(BaseSampler):
       return multihop_sample_hetero(
           one_hops, trav, self.num_neighbors, self.num_hops, caps,
           budgets, seeds, n_valid, key, tables,
-          with_edge=self.with_edge)
+          with_edge=self.with_edge, fused_plan=fused_plan)
 
     return jax.jit(fn, donate_argnums=(3,))
 
@@ -576,6 +646,7 @@ class NeighborSampler(BaseSampler):
         {t: jnp.asarray(v) for t, v in n_valid.items()},
         key if key is not None else self._next_key(), tables)
     self._tables.update(new_tables)
+    self._update_table_occupancy(out)
 
     # final keys: 'out' reverses the traversal type, 'in' keeps it; row
     # must carry child labels (= our cols), col parent labels (= our rows)

@@ -32,9 +32,10 @@ import numpy as np
 
 from ..data import Dataset
 from ..data.feature import gather_features
-from ..loader.transform import to_batch
+from ..loader.transform import to_batch, to_hetero_batch
 from ..obs import get_tracer
 from ..sampler import NeighborSampler
+from ..sampler.base import NodeSamplerInput
 from ..utils import as_numpy
 from .embedding_cache import EmbeddingCache
 
@@ -66,6 +67,12 @@ class InferenceEngine:
       inject the interpret-mode Pallas kernel). Applied at the gather
       CALL SITE, so it keeps serving after ``update_snapshot`` swaps
       in a new stream Feature.
+    input_type: REQUIRED for a hetero ``data.graph`` (dict): the seed
+      node type requests address. Buckets pad the seed-type batch; the
+      pipeline samples every edge type (one fused multi-edge-type
+      kernel invocation per hop on the ``pallas_fused`` engine) and
+      the forward consumes a ``HeteroBatch`` — RGAT-style serving with
+      the same zero-steady-state-recompile contract as homo.
   """
 
   def __init__(self, data: Dataset, model, params,
@@ -78,10 +85,19 @@ class InferenceEngine:
                apply_fn: Optional[Callable] = None,
                with_edge: bool = False,
                sampler=None,
-               row_gather=None):
-    assert not isinstance(data.graph, dict), (
-        'serving engine is homogeneous-only for now (hetero serving '
-        'needs per-type bucket grids)')
+               row_gather=None,
+               input_type=None):
+    self._hetero = isinstance(data.graph, dict)
+    if self._hetero:
+      # hetero serving: requests are seed-type node ids; the bucketed
+      # pipeline samples the multi-edge-type neighborhood (one fused
+      # program per bucket — on the pallas_fused engine each hop is one
+      # multi-edge-type kernel invocation) and the forward consumes a
+      # HeteroBatch. Bucket grid stays 1-D: requests seed ONE type.
+      assert input_type is not None, (
+          'hetero serving needs input_type (the seed node type '
+          'requests address)')
+    self.input_type = input_type
     self.data = data
     self.model = model
     self.params = params
@@ -91,8 +107,10 @@ class InferenceEngine:
     self.cache = cache if cache is not None \
         else EmbeddingCache(cache_capacity)
     self.sampler = sampler if sampler is not None else NeighborSampler(
-        data.graph, list(num_neighbors), edge_dir=data.edge_dir,
-        with_edge=with_edge, seed=seed)
+        data.graph,
+        dict(num_neighbors) if isinstance(num_neighbors, dict)
+        else list(num_neighbors),
+        edge_dir=data.edge_dir, with_edge=with_edge, seed=seed)
     self.row_gather = row_gather
     self._apply_fn = apply_fn or (
         lambda params, batch: self.model.apply(params, batch))
@@ -165,6 +183,10 @@ class InferenceEngine:
 
   @property
   def num_nodes(self) -> int:
+    """Id-space bound for request validation: the seed TYPE's node
+    count on a hetero graph (requests address one type)."""
+    if self._hetero:
+      return self.sampler._node_counts[self.input_type]
     return self.data.graph.num_nodes
 
   def validate_ids(self, ids: np.ndarray) -> None:
@@ -187,7 +209,21 @@ class InferenceEngine:
   def make_batch(self, seeds: np.ndarray, n_valid: int, bucket: int):
     """Sample + gather a bucket-shaped Batch exactly as serving runs
     it (public so param init / benchmarks build batches through the
-    same pipeline instead of re-rolling it)."""
+    same pipeline instead of re-rolling it). Hetero graphs produce a
+    :class:`~glt_tpu.loader.transform.HeteroBatch` (per-type feature
+    gather over the sampled node dict)."""
+    if self._hetero:
+      out = self.sampler.sample_from_nodes(
+          NodeSamplerInput(seeds, self.input_type), n_valid=n_valid)
+      # featureless node types are legal (node_loader tolerates partial
+      # feature dicts the same way): gather only the types with a store
+      feats = (self.data.node_features
+               if isinstance(self.data.node_features, dict) else {})
+      x_dict = {
+          t: gather_features(feats[t], n, row_gather=self.row_gather)
+          for t, n in out.node.items() if feats.get(t) is not None}
+      return to_hetero_batch(out, x_dict=x_dict,
+                             batch_size=bucket).replace(metadata=None)
     out = self.sampler.sample_from_nodes(seeds, n_valid=n_valid)
     # a pallas_fused sampler built with fused_feature= hands the rows
     # back pre-gathered (in-walk); gather_features passes them through
@@ -334,6 +370,15 @@ class InferenceEngine:
 
     Returns the number of cache entries dropped.
     """
+    if self._hetero:
+      # the stream/snapshot machinery is homogeneous (StreamSampler,
+      # Snapshot.feature are single-type); silently installing a homo
+      # Feature over the per-type dict would serve featureless hetero
+      # batches from then on — refuse loudly instead
+      raise NotImplementedError(
+          'update_snapshot is homogeneous-only: hetero serving has no '
+          'stream snapshot lineage yet (invalidate_nodes/invalidate '
+          'remain available)')
     with self._lock:
       if snapshot.feature is not None:
         self.data.node_features = snapshot.feature

@@ -299,6 +299,92 @@ def test_dist_hetero_train_step(tmp_path_factory, mesh):
   assert losses[-1] < losses[0], f'no learning: {losses[::6]}'
 
 
+def test_dist_hetero_train_superstep(tmp_path_factory, mesh):
+  """K hetero train batches in ONE donated dispatch (ISSUE 14
+  tentpole, program half): superstep loss trajectory bit-identical to
+  K sequential per-batch calls on the same key stream, with zero
+  steady-state recompiles across repeated supersteps of the same T."""
+  import optax
+  from glt_tpu.distributed import (
+      DistDataset, DistFeature, DistHeteroGraph, DistHeteroTrainStep,
+  )
+  from glt_tpu.models import RGNN
+  from glt_tpu.typing import reverse_edge_type
+  root = str(tmp_path_factory.mktemp('hetero_superstep'))
+  u2i = ('user', 'u2i', 'item')
+  i2i = ('item', 'i2i', 'item')
+  nu, ni = 16, 32
+  u = np.arange(nu)
+  u2i_ei = np.stack([np.repeat(u, 2),
+                     np.stack([2*u, 2*u+1], 1).reshape(-1) % ni])
+  i = np.arange(ni)
+  i2i_ei = np.stack([np.repeat(i, 2),
+                     np.stack([(i+1) % ni, (i+2) % ni], 1).reshape(-1)])
+  w = max(nu, ni)
+  feats = {'user': np.pad(np.eye(nu, dtype=np.float32),
+                          ((0, 0), (0, w - nu))),
+           'item': np.pad(np.eye(ni, dtype=np.float32),
+                          ((0, 0), (0, w - ni)))}
+  RandomPartitioner(root, num_parts=N_PARTS,
+                    num_nodes={'user': nu, 'item': ni},
+                    edge_index={u2i: u2i_ei, i2i: i2i_ei},
+                    node_feat=feats).partition()
+  dg = DistHeteroGraph.from_dataset_partitions(mesh, root)
+  dss = [DistDataset().load(root, p) for p in range(N_PARTS)]
+  dfeats = {t: DistFeature.from_dist_datasets(mesh, dss, ntype=t)
+            for t in ('user', 'item')}
+  labels = {'user': (np.arange(nu) % 3).astype(np.int32)}
+  # 1 hop / 1 layer: the parity + zero-recompile claims are about the
+  # scan lift, not model depth — tier-1 time budget matters here
+  model = RGNN(edge_types=[reverse_edge_type(u2i), i2i],
+               hidden_features=8, out_features=3, num_layers=1,
+               conv='rsage')
+  tx = optax.adam(1e-2)
+
+  def build():
+    return DistHeteroTrainStep(dg, dfeats, model, tx, labels,
+                               {u2i: [2], i2i: [2]},
+                               batch_size_per_device=2,
+                               seed_type='user', seed=0)
+
+  T = 2
+  rng = np.random.default_rng(0)
+  seeds = rng.integers(0, nu, (T, N_PARTS, 2))
+  keys = jnp.stack([jax.random.split(jax.random.key(t), N_PARTS)
+                    for t in range(T)])
+
+  step = build()
+  params = step.init_params(jax.random.key(0))
+  opt = tx.init(params)
+  seq = []
+  for t in range(T):
+    params, opt, loss = step(params, opt, seeds[t],
+                             np.full(N_PARTS, 2), jax.random.key(t))
+    seq.append(np.asarray(loss))
+
+  step2 = build()
+  params2 = step2.init_params(jax.random.key(0))
+  opt2 = tx.init(params2)
+  from glt_tpu.obs import get_registry
+  compiles0 = get_registry().get('compiles_total',
+                                 fn='train.hetero_superstep')
+  params2, opt2, loss_ss = step2.superstep(
+      params2, opt2, seeds.reshape(T, -1), np.full((T, N_PARTS), 2),
+      keys)
+  np.testing.assert_array_equal(np.asarray(loss_ss), np.stack(seq))
+  assert step2.superstep_traces == 1
+  compiles1 = get_registry().get('compiles_total',
+                                 fn='train.hetero_superstep')
+  assert compiles1 == compiles0 + 1
+  params2, opt2, _ = step2.superstep(
+      params2, opt2, seeds.reshape(T, -1), np.full((T, N_PARTS), 2),
+      keys)
+  assert step2.superstep_traces == 1  # steady state: zero recompiles
+  # the process-wide counter agrees: one trace served both supersteps
+  assert get_registry().get('compiles_total',
+                            fn='train.hetero_superstep') == compiles1
+
+
 def test_dist_weighted_sampling(tmp_path_factory, mesh):
   """Distributed weighted sampling: the dominant-weight edge is sampled
   nearly always (reference parity: weighted sampling works through the

@@ -608,6 +608,301 @@ def test_walk_demotes_to_per_hop_for_slot_eids(monkeypatch):
   assert 'edge' in got  # slot-contract eids still emitted
 
 
+# -- hetero: one multi-edge-type kernel invocation per hop (ISSUE 14) --
+#
+# The pallas_fused engine serves HETERO walks: each hop's per-edge-type
+# sampling is batched into ONE padded sample_hop_dedup invocation over
+# the flat edge-type plane (type-tagged global ids = per-type dedup
+# namespaces in one VMEM table). Parity target: the per-edge-type
+# sorted reference, GLT_DEDUP=sort GLT_FUSED_HOP=1.
+
+U2I = ('user', 'u2i', 'item')
+I2I = ('item', 'i2i', 'item')
+
+HETERO_NODE_KEYS = ('node', 'node_count', 'num_sampled_nodes')
+HETERO_EDGE_KEYS = ('row', 'col', 'edge_mask', 'num_sampled_edges')
+
+
+def _hetero_ref_vs_fused(ds, nn, inputs, nv, monkeypatch, seed=4,
+                         with_edge=False, **sampler_kw):
+  from glt_tpu.sampler import NeighborSampler
+  monkeypatch.delenv('GLT_HOP_ENGINE', raising=False)
+  monkeypatch.setenv('GLT_DEDUP', 'sort')
+  monkeypatch.setenv('GLT_FUSED_HOP', '1')
+  base = NeighborSampler(
+      ds.graph, nn, seed=seed, with_edge=with_edge,
+      **sampler_kw)._hetero_sample_from_nodes(inputs, n_valid=nv)
+  monkeypatch.delenv('GLT_DEDUP')
+  monkeypatch.delenv('GLT_FUSED_HOP')
+  monkeypatch.setenv('GLT_HOP_ENGINE', 'pallas_fused')
+  monkeypatch.setenv('GLT_WINDOW_W', '8')
+  samp = NeighborSampler(ds.graph, nn, seed=seed, with_edge=with_edge,
+                         **sampler_kw)
+  out = samp._hetero_sample_from_nodes(inputs, n_valid=nv)
+  return base, out, samp
+
+
+def _assert_hetero_identical(base, out, with_edge=False):
+  for t in base.node:
+    for k in HETERO_NODE_KEYS:
+      np.testing.assert_array_equal(
+          np.asarray(getattr(base, k)[t]),
+          np.asarray(getattr(out, k)[t]), err_msg=f'{k}[{t}]')
+  for e in base.row:
+    for k in HETERO_EDGE_KEYS:
+      np.testing.assert_array_equal(
+          np.asarray(getattr(base, k)[e]),
+          np.asarray(getattr(out, k)[e]), err_msg=f'{k}[{e}]')
+    if with_edge:
+      m = np.asarray(base.edge_mask[e]).astype(bool)
+      np.testing.assert_array_equal(np.asarray(base.edge[e])[m],
+                                    np.asarray(out.edge[e])[m],
+                                    err_msg=f'edge[{e}]')
+  for t in base.batch:
+    np.testing.assert_array_equal(np.asarray(base.batch[t]),
+                                  np.asarray(out.batch[t]),
+                                  err_msg=f'batch[{t}]')
+    np.testing.assert_array_equal(
+        np.asarray(base.metadata['seed_labels'][t]),
+        np.asarray(out.metadata['seed_labels'][t]),
+        err_msg=f'seed_labels[{t}]')
+
+
+def _hub_hetero_dataset(nu=8, ni=24, hub_deg=14):
+  """item 0 is a HUB in i2i (degree > the forced W=8); every other row
+  in both types stays far below the window — the hub fix-up must fire
+  for exactly one type's segment of the concatenated frontier."""
+  from glt_tpu.data import Dataset
+  u = np.arange(nu)
+  u2i_ei = np.stack([np.repeat(u, 2),
+                     np.stack([2 * u, 2 * u + 1], 1).reshape(-1) % ni])
+  hub_dst = (np.arange(hub_deg) + 1) % ni
+  i = np.arange(1, ni)
+  i2i_ei = np.stack([
+      np.concatenate([np.zeros(hub_deg, np.int64), i]),
+      np.concatenate([hub_dst, (i + 1) % ni])])
+  ds = Dataset(edge_dir='out')
+  ds.init_graph(edge_index={U2I: u2i_ei, I2I: i2i_ei},
+                num_nodes={'user': nu, 'item': ni})
+  return ds
+
+
+@pytest.mark.slow  # two full hetero program traces per param on 1 CPU;
+                   # the pallas-interpret CI job (-m pallas) runs it
+@pytest.mark.parametrize('with_edge', [False, True])
+def test_hetero_bit_identical_to_per_etype_sorted_ref(monkeypatch,
+                                                      with_edge):
+  from fixtures import hetero_ring_dataset
+  ds = hetero_ring_dataset(num_users=10, num_items=20)
+  seeds = np.array([3, 0, 3, 7, 9, 1], np.int64)  # duplicate seeds
+  base, out, _ = _hetero_ref_vs_fused(
+      ds, {U2I: [2, 2], I2I: [2, 2]}, ('user', seeds), 5, monkeypatch,
+      with_edge=with_edge)
+  _assert_hetero_identical(base, out, with_edge=with_edge)
+
+
+def test_hetero_hub_rows_in_one_type_only(monkeypatch):
+  ds = _hub_hetero_dataset()
+  seeds = np.array([3, 0, 3, 7], np.int64)
+  base, out, _ = _hetero_ref_vs_fused(
+      ds, {U2I: [2, 2], I2I: [3, 2]}, ('user', seeds), 4, monkeypatch)
+  _assert_hetero_identical(base, out)
+
+
+def test_hetero_empty_frontier_and_n_valid_zero(monkeypatch):
+  ds = _hub_hetero_dataset()
+  seeds = np.array([3, 0, 3, 7], np.int64)
+  base, out, _ = _hetero_ref_vs_fused(
+      ds, {U2I: [2, 2], I2I: [3, 2]}, ('user', seeds), 0, monkeypatch)
+  _assert_hetero_identical(base, out)
+  assert all(int(c) == 0 for c in
+             jax.tree_util.tree_leaves(out.node_count))
+
+
+def test_hetero_zero_budget_type_and_empty_etype(monkeypatch):
+  from glt_tpu.data import Dataset
+  nu, ni = 6, 12
+  u = np.arange(nu)
+  u2i_ei = np.stack([np.repeat(u, 2),
+                     np.stack([2 * u, 2 * u + 1], 1).reshape(-1) % ni])
+  # zero-budget type: nothing ever expands INTO 'user', so its caps
+  # are 0 past hop 0 and the u2i frontier dies after hop 1
+  ds = Dataset(edge_dir='out')
+  ds.init_graph(edge_index={U2I: u2i_ei},
+                num_nodes={'user': nu, 'item': ni})
+  base, out, _ = _hetero_ref_vs_fused(
+      ds, {U2I: [2, 2]}, ('user', np.array([1, 2, 5], np.int64)), 3,
+      monkeypatch)
+  _assert_hetero_identical(base, out)
+  # empty per-type frontier via a zero-EDGE etype: i2i exists in the
+  # schema but holds no edges — its segments ride the invocation as
+  # all-invalid lanes, exactly the reference's _empty_output chunks
+  ds2 = Dataset(edge_dir='out')
+  ds2.init_graph(edge_index={U2I: u2i_ei, I2I: np.zeros((2, 0),
+                                                        np.int64)},
+                 num_nodes={'user': nu, 'item': ni})
+  base2, out2, _ = _hetero_ref_vs_fused(
+      ds2, {U2I: [2, 2], I2I: [2, 2]},
+      ('user', np.array([1, 2, 5], np.int64)), 3, monkeypatch)
+  _assert_hetero_identical(base2, out2)
+
+
+@pytest.mark.slow  # 4 hetero program traces; runs in the -m pallas job
+def test_hetero_two_type_seeding_and_mixed_fanouts(monkeypatch):
+  from fixtures import hetero_ring_dataset
+  ds = hetero_ring_dataset(num_users=10, num_items=20)
+  base, out, _ = _hetero_ref_vs_fused(
+      ds, {U2I: [2, 2], I2I: [2, 2]},
+      {'user': np.array([1, 2, 5], np.int64),
+       'item': np.array([0, 7, 7, 3], np.int64)}, 3, monkeypatch)
+  _assert_hetero_identical(base, out)
+  # per-etype fanouts differ: the K_max offset/validity padding path
+  base2, out2, _ = _hetero_ref_vs_fused(
+      ds, {U2I: [3, 1], I2I: [1, 2]},
+      ('user', np.array([4, 4, 0, 9], np.int64)), 4, monkeypatch)
+  _assert_hetero_identical(base2, out2)
+
+
+def test_hetero_sampler_zero_recompiles_and_honest_fallbacks(
+    monkeypatch):
+  # hetero is SERVED by the fused family: no `hetero` fallback reason
+  # fires for a plain hetero sampler, the one compiled program serves
+  # every steady-state call, and the specific reasons (weighted,
+  # table_overflow) keep firing with the requested label honest
+  from fixtures import hetero_ring_dataset
+  from glt_tpu.obs import MetricsRegistry, get_registry, set_registry
+  from glt_tpu.sampler import NeighborSampler
+  prev = set_registry(MetricsRegistry())
+  try:
+    monkeypatch.setenv('GLT_HOP_ENGINE', 'pallas_fused')
+    monkeypatch.setenv('GLT_WINDOW_W', '8')
+    ds = hetero_ring_dataset(num_users=10, num_items=20)
+    samp = NeighborSampler(ds.graph, {U2I: [2, 2], I2I: [2, 2]},
+                           seed=0)
+    seeds = np.arange(6)
+    samp._hetero_sample_from_nodes(('user', seeds))
+    assert samp.num_compiled_fns == 1
+    for _ in range(3):
+      samp._hetero_sample_from_nodes(('user', seeds))
+    assert samp.num_compiled_fns == 1
+    snap = get_registry().snapshot()
+    hetero_fb = [k for k in snap['counters']
+                 if 'hop_engine_fallbacks_total' in k
+                 and 'hetero' in k]
+    assert not hetero_fb, hetero_fb
+    # a table past the VMEM sizing knob is a SPECIFIC reason (never
+    # the blanket `hetero`), requested label honest
+    monkeypatch.setenv('GLT_FUSED_TABLE_SLOTS', '512')
+    osamp = NeighborSampler(ds.graph, {U2I: [4, 4], I2I: [4, 4]},
+                            seed=0)
+    out = osamp._hetero_sample_from_nodes(('user', np.arange(8)))
+    assert int(out.node_count['item']) > 0  # demoted engine still works
+    assert get_registry().get('hop_engine_fallbacks_total',
+                              requested='pallas_fused',
+                              resolved='pallas',
+                              reason='table_overflow') == 1
+  finally:
+    set_registry(prev)
+
+
+@pytest.mark.slow  # two serving warmups (4 program traces); -m pallas job
+def test_hetero_serving_parity_and_zero_recompiles(monkeypatch):
+  # hetero bucket serving (input_type seeding, HeteroBatch forward):
+  # embeddings match the per-etype sorted reference and warmup
+  # compiles stay flat with the fused hetero engine forced
+  from fixtures import hetero_ring_dataset
+  from glt_tpu.serving import InferenceEngine
+  ds = hetero_ring_dataset(num_users=10, num_items=20)
+  nn = {U2I: [2, 2], I2I: [2, 2]}
+  apply_fn = lambda params, batch: \
+      batch.x_dict['user'][:batch.batch_size, :4] * 2.0
+
+  monkeypatch.setenv('GLT_DEDUP', 'sort')
+  monkeypatch.setenv('GLT_FUSED_HOP', '1')
+  base = InferenceEngine(ds, model=None, params={}, num_neighbors=nn,
+                         buckets=(8,), apply_fn=apply_fn, seed=0,
+                         cache_capacity=0, input_type='user')
+  base.warmup()
+  want = base.infer(np.arange(6))
+  monkeypatch.delenv('GLT_DEDUP')
+  monkeypatch.delenv('GLT_FUSED_HOP')
+
+  monkeypatch.setenv('GLT_HOP_ENGINE', 'pallas_fused')
+  monkeypatch.setenv('GLT_WINDOW_W', '8')
+  eng = InferenceEngine(ds, model=None, params={}, num_neighbors=nn,
+                        buckets=(8,), apply_fn=apply_fn, seed=0,
+                        cache_capacity=0, input_type='user')
+  eng.warmup()
+  got = eng.infer(np.arange(6))
+  np.testing.assert_array_equal(want, got)
+  stats = eng.compile_stats()
+  for _ in range(4):
+    eng.infer(np.arange(6))
+  assert eng.compile_stats()['forward_traces'] == \
+      stats['forward_traces']
+  assert eng.compile_stats()['sampler_compiled_fns'] == \
+      stats['sampler_compiled_fns']
+
+
+@pytest.mark.slow  # whole-superstep scan trace in interpret; -m pallas job
+def test_hetero_superstep_scan_parity_and_one_trace(monkeypatch):
+  # K hetero batches in ONE dispatch (multihop_sample_hetero_many):
+  # results identical to K per-batch calls on the same key stream,
+  # one trace serves every superstep call — the dispatch collapse the
+  # bench records as dispatches_per_step 1 -> 1/K
+  from fixtures import hetero_ring_dataset
+  from glt_tpu.ops.pipeline import (multihop_sample_hetero,
+                                    multihop_sample_hetero_many)
+  from glt_tpu.sampler import NeighborSampler
+  monkeypatch.setenv('GLT_HOP_ENGINE', 'pallas_fused')
+  monkeypatch.setenv('GLT_WINDOW_W', '8')
+  ds = hetero_ring_dataset(num_users=10, num_items=20)
+  nn = {U2I: [2, 2], I2I: [2, 2]}
+  samp = NeighborSampler(ds.graph, nn, seed=0)
+  batch_sizes = {'user': 6}
+  trav = samp._traversal_types()
+  caps, budgets = samp._hetero_caps(batch_sizes)
+  plan = samp._hetero_fused_plan(batch_sizes)
+  assert plan is not None
+  one_hops = {e: (lambda ids, f, k, m, _e=e: samp._one_hop(
+      samp.graph[_e], ids, f, k, m)) for e in samp.edge_types}
+  tables = {t: samp._get_tables(t, n)
+            for t, n in samp._node_counts.items()}
+  T = 3
+  seeds = jnp.asarray(np.random.default_rng(0).integers(
+      0, 10, (T, 6)).astype(np.int32))
+  nv = jnp.full((T,), 6, jnp.int32)
+  key = jax.random.key(7)
+  traces = {'n': 0}
+
+  @jax.jit
+  def run_super(seeds_stack, nv_stack, key, tables):
+    traces['n'] += 1  # trace-time side effect only
+    return multihop_sample_hetero_many(
+        one_hops, trav, samp.num_neighbors, samp.num_hops, caps,
+        budgets, {'user': seeds_stack}, {'user': nv_stack}, key,
+        tables, fused_plan=plan)
+
+  outs, tables = run_super(seeds, nv, key, tables)
+  outs2, tables = run_super(seeds, nv, key, tables)
+  assert traces['n'] == 1  # one dispatch per K batches, zero recompile
+  k = key
+  for t in range(T):
+    k, sub = jax.random.split(k)
+    one, tables = multihop_sample_hetero(
+        one_hops, trav, samp.num_neighbors, samp.num_hops, caps,
+        budgets, {'user': seeds[t]}, {'user': nv[t]}, sub, tables,
+        fused_plan=plan)
+    for ty in one['node']:
+      np.testing.assert_array_equal(np.asarray(outs['node'][ty])[t],
+                                    np.asarray(one['node'][ty]),
+                                    err_msg=f'node[{ty}] step {t}')
+    for e in one['row']:
+      np.testing.assert_array_equal(np.asarray(outs['row'][e])[t],
+                                    np.asarray(one['row'][e]),
+                                    err_msg=f'row[{e}] step {t}')
+
+
 def test_fused_walk_mode_knob(monkeypatch):
   from glt_tpu.ops.pipeline import fused_walk_mode
   monkeypatch.delenv('GLT_FUSED_WALK', raising=False)
