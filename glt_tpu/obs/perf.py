@@ -41,6 +41,7 @@ import os
 import time
 from typing import Optional
 
+from ..utils.env import knob
 from .registry import MetricsRegistry, get_registry
 
 logger = logging.getLogger(__name__)
@@ -81,8 +82,7 @@ def xla_cost_enabled() -> bool:
   """Whether opt-in AOT cost publication runs at compile points whose
   trace counters are test-pinned (serving warmup). ``GLT_OBS_XLA_COST=1``
   opts in; default off because the AOT ``lower()`` is an extra trace."""
-  return os.environ.get('GLT_OBS_XLA_COST', '0') not in (
-      '0', '', 'false')
+  return knob('GLT_OBS_XLA_COST', False)
 
 
 def _flatten_cost(cost) -> dict:
@@ -211,7 +211,7 @@ def instrument_compiled(fn_name: str, stage=None, *args,
 # -- measured rooflines ---------------------------------------------------
 
 def default_cache_path() -> str:
-  return os.environ.get(
+  return knob(
       'GLT_ROOFLINE_CACHE',
       os.path.join(os.path.expanduser('~'), '.cache', 'glt_tpu',
                    'roofline.json'))

@@ -46,12 +46,13 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from ..utils.env import knob
 from .registry import MetricsRegistry, get_registry
 from .trace import Tracer, get_tracer
 
 
 def postmortem_dir() -> Optional[str]:
-  return os.environ.get('GLT_OBS_POSTMORTEM_DIR') or None
+  return knob('GLT_OBS_POSTMORTEM_DIR', None) or None
 
 
 class FlightRecorder:
@@ -78,14 +79,10 @@ class FlightRecorder:
                registry: Optional[MetricsRegistry] = None,
                tracer: Optional[Tracer] = None):
     if min_dump_interval_s is None:
-      # a malformed knob must not crash `import glt_tpu.obs` (the
-      # module-level recorder runs this at import — the GLT_OBS_BUFFER
-      # bug class)
-      try:
-        min_dump_interval_s = float(
-            os.environ.get('GLT_OBS_POSTMORTEM_MIN_S', '30') or 30)
-      except ValueError:
-        min_dump_interval_s = 30.0
+      # knob() warns-and-defaults on a malformed value, so this can
+      # never crash `import glt_tpu.obs` (the module-level recorder
+      # runs this at import — the GLT_OBS_BUFFER bug class)
+      min_dump_interval_s = knob('GLT_OBS_POSTMORTEM_MIN_S', 30.0)
     self._events: 'deque[dict]' = deque(maxlen=max(int(capacity), 16))
     self._lock = threading.Lock()
     self._dump_dir = dump_dir
@@ -258,7 +255,7 @@ def parse_slo_env(spec: Optional[str] = None) -> List[SloPolicy]:
   -> policies. Metric may carry labels:
   ``stage_seconds{stage=serve.infer}``."""
   if spec is None:
-    spec = os.environ.get('GLT_OBS_SLO', '')
+    spec = knob('GLT_OBS_SLO', '')
   out = []
   for chunk in (spec or '').split(';'):
     chunk = chunk.strip()

@@ -42,6 +42,7 @@ import time
 from collections import deque
 from typing import Iterable, List, NamedTuple, Optional
 
+from ..utils.env import knob
 from .registry import MetricsRegistry, get_registry
 
 
@@ -191,16 +192,14 @@ class Tracer:
                buffer: Optional[int] = None,
                registry: Optional[MetricsRegistry] = None):
     if enabled is None:
-      enabled = os.environ.get('GLT_OBS_TRACE', '0') not in (
-          '0', '', 'false')
+      enabled = knob('GLT_OBS_TRACE', False)
     if sample is None:
-      sample = float(os.environ.get('GLT_OBS_TRACE_SAMPLE', '0') or 0)
+      sample = knob('GLT_OBS_TRACE_SAMPLE', 0.0)
     if buffer is None:
-      buffer = int(os.environ.get('GLT_OBS_BUFFER') or 65536)
+      buffer = knob('GLT_OBS_BUFFER', 65536)
     self.enabled = bool(enabled)
     self._sample = min(max(float(sample), 0.0), 1.0)
-    self._annotate = os.environ.get('GLT_OBS_ANNOTATE', '1') not in (
-        '0', 'false')
+    self._annotate = knob('GLT_OBS_ANNOTATE', True)
     self._spans: 'deque[Span]' = deque(maxlen=max(int(buffer), 16))
     self._lock = threading.Lock()
     self._pid = os.getpid()

@@ -37,10 +37,11 @@ over the fresh unique ids — strictly narrower than the 3-operand
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
+
+from ..utils.env import knob
 
 #: trace-time kernel-launch accounting: every pallas_call built by this
 #: module bumps the counter ONCE PER TRACE (executions never touch it).
@@ -130,7 +131,7 @@ def auto_probe_ok() -> bool:
 
 
 def use_pallas_default() -> bool:
-  if os.environ.get('GLT_USE_PALLAS', '') not in ('1', 'true', 'True'):
+  if not knob('GLT_USE_PALLAS', False):
     return False
   return (pallas_available()
           and jax.default_backend() == 'tpu')
@@ -142,7 +143,7 @@ def interpret_default() -> bool:
   tier-1 CPU suite, the CI interpret job) executes them through the
   interpreter. On TPU, GLT_PALLAS_INTERPRET=1 forces interpretation for
   debugging."""
-  if os.environ.get('GLT_PALLAS_INTERPRET', '') in ('1', 'true', 'True'):
+  if knob('GLT_PALLAS_INTERPRET', False):
     return True
   return jax.default_backend() != 'tpu'
 
@@ -504,7 +505,7 @@ def fused_table_max_slots() -> int:
   double-buffers inside a 16 MB VMEM budget. A multihop whose node
   budget needs more slots falls back to the ``pallas`` engine (counted
   in ``hop_engine_fallbacks_total``)."""
-  return int(os.environ.get('GLT_FUSED_TABLE_SLOTS', str(1 << 20)))
+  return knob('GLT_FUSED_TABLE_SLOTS', 1 << 20)
 
 
 def fused_table_slots(budget: int) -> int:

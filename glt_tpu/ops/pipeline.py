@@ -8,12 +8,12 @@ in the NeighborSampler docstring.
 """
 from __future__ import annotations
 
-import os
 from typing import Callable, Dict, List, Sequence
 
 import jax
 import jax.numpy as jnp
 
+from ..utils.env import knob
 from .sample import NeighborOutput
 from .unique import (dense_assign, dense_init, dense_reset,
                      sorted_hop_dedup, sorted_hop_dedup_fused,
@@ -30,11 +30,11 @@ def dedup_engine() -> str:
   GLT_DEDUP=table|sort|auto overrides; auto picks by backend. The
   hetero sorted path restores slot order with one extra per-type sort
   so per-etype slicing stays exact."""
-  mode = os.environ.get('GLT_DEDUP', 'auto')
+  mode = knob('GLT_DEDUP', 'auto')
   if mode not in ('auto', 'sort', 'table'):
     raise ValueError(f'GLT_DEDUP={mode!r}: expected auto|sort|table')
   if mode == 'auto':
-    if os.environ.get('GLT_HOP_ENGINE') == 'pallas_fused':
+    if knob('GLT_HOP_ENGINE', '') == 'pallas_fused':
       # the fused engine implements the sort/fused inducer CONTRACT in
       # its kernel (and its fallbacks land on the sort path), so the
       # auto dedup choice follows it on every backend — flipping to
@@ -60,7 +60,7 @@ def fused_hops() -> bool:
   and fused >= plain in every scan/PRNG variant measured that round);
   OFF elsewhere (CPU measured it neutral-to-slower under contention).
   GLT_FUSED_HOP=1|0 forces."""
-  mode = os.environ.get('GLT_FUSED_HOP', 'auto').lower()
+  mode = knob('GLT_FUSED_HOP', 'auto').lower()
   if mode == 'auto':
     return dedup_engine() == 'sort' and jax.default_backend() == 'tpu'
   return mode in ('1', 'true')
@@ -94,7 +94,7 @@ def fused_walk_mode() -> str:
   interpret compile on every CPU parity/CI run. Forced values apply
   everywhere (the parity tests and the bench cost duel force
   ``cross`` in interpret mode deliberately)."""
-  mode = os.environ.get('GLT_FUSED_WALK', 'auto')
+  mode = knob('GLT_FUSED_WALK', 'auto')
   if mode not in ('auto', 'cross', 'per_hop'):
     raise ValueError(
         f'GLT_FUSED_WALK={mode!r}: expected auto|cross|per_hop')
@@ -180,13 +180,13 @@ def hop_engine() -> str:
   results are bit-identical (ops/sample.py; ``pallas_fused`` is
   bit-identical to the ``sort+fused`` dedup engine, which it
   subsumes). Read at trace time, like :func:`dedup_engine`."""
-  mode = os.environ.get('GLT_HOP_ENGINE', 'auto')
+  mode = knob('GLT_HOP_ENGINE', 'auto')
   if mode not in ('auto',) + HOP_ENGINES:
     raise ValueError(
         f'GLT_HOP_ENGINE={mode!r}: expected '
         'auto|element|window|pallas|pallas_fused')
   if mode == 'auto':
-    if os.environ.get('GLT_HOP_ENGINE_AUTO', '1') in ('0', 'false'):
+    if not knob('GLT_HOP_ENGINE_AUTO', True):
       return 'element'
     if jax.default_backend() != 'tpu':
       return 'element'

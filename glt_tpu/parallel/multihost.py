@@ -20,12 +20,13 @@ helpers cover the two framework needs:
 from __future__ import annotations
 
 import logging
-import os
 from typing import Optional
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils.env import raw as raw_env
 
 logger = logging.getLogger(__name__)
 
@@ -53,7 +54,7 @@ def initialize(coordinator_address: Optional[str] = None,
         coordinator_address=coordinator_address,
         num_processes=num_processes, process_id=process_id)
     return
-  if any(os.environ.get(k) for k in _CLUSTER_ENVS):
+  if any(raw_env(k) for k in _CLUSTER_ENVS):
     jax.distributed.initialize()
     return
   logger.debug('multihost.initialize: no cluster environment detected; '

@@ -28,13 +28,14 @@ message plane.
 from __future__ import annotations
 
 import logging
-import os
 import random
 import socket
 import struct
 import threading
 import time
 from typing import Dict, Optional
+
+from ..utils.env import knob
 
 logger = logging.getLogger(__name__)
 
@@ -50,10 +51,7 @@ _FAULTS = (DELAY, DROP, DISCONNECT, TRUNCATE)
 def chaos_seed(default: int = 0) -> int:
   """The run-wide chaos seed (env ``GLT_CHAOS_SEED``). CI pins it so
   every fault scenario replays identically on every PR."""
-  try:
-    return int(os.environ.get('GLT_CHAOS_SEED', default))
-  except ValueError:
-    return default
+  return knob('GLT_CHAOS_SEED', int(default))
 
 
 class FaultPlan:

@@ -19,7 +19,6 @@ traversal type itself.
 from __future__ import annotations
 
 import logging
-import os
 from typing import Dict, List, Optional, Union
 
 import jax
@@ -39,6 +38,7 @@ from ..obs import get_tracer
 from ..ops.subgraph import induced_subgraph
 from ..typing import EdgeType, NodeType, reverse_edge_type
 from ..utils import as_numpy
+from ..utils.env import knob
 from ..utils.rng import RandomSeedManager
 from .base import (
     BaseSampler, HeteroSamplerOutput, NodeSamplerInput, SamplerOutput,
@@ -56,7 +56,7 @@ def _window_width() -> int:
   default 96, floored at 8) — ONE definition so the homo plan, the
   hetero plan, and the demoted per-hop window read can never disagree
   on the geometry they share."""
-  return max(int(os.environ.get('GLT_WINDOW_W', '96')), 8)
+  return max(knob('GLT_WINDOW_W', 96), 8)
 
 
 class NeighborSampler(BaseSampler):
@@ -232,7 +232,7 @@ class NeighborSampler(BaseSampler):
     dashboard can tell a deliberate engine request from a default."""
     if reason not in self._fallbacks_counted:
       self._fallbacks_counted.add(reason)
-      requested = os.environ.get('GLT_HOP_ENGINE', 'auto')
+      requested = knob('GLT_HOP_ENGINE', 'auto')
       if getattr(self, '_hop_engine_override', None):
         requested = self._hop_engine_override
       count_engine_fallback(requested, resolved, reason)
@@ -260,7 +260,7 @@ class NeighborSampler(BaseSampler):
     if any(f < 0 for f in fanouts):
       self._count_fallback('full_neighborhood')
       return 'pallas'
-    if os.environ.get('GLT_DEDUP') == 'table':
+    if knob('GLT_DEDUP', '') == 'table':
       self._count_fallback('dense_dedup_forced')
       return 'pallas'
     return eng
@@ -308,7 +308,7 @@ class NeighborSampler(BaseSampler):
       # emitted node_feats) carry this dtype, halving the gather's HBM
       # write traffic for float32 stores. A widening request is
       # ignored — the plane never up-converts.
-      narrow = os.environ.get('GLT_FUSED_FEAT_DTYPE')
+      narrow = knob('GLT_FUSED_FEAT_DTYPE', None)
       if narrow:
         narrow = jnp.dtype(narrow)
         if narrow.itemsize < jnp.dtype(feat_dtype).itemsize:
@@ -406,8 +406,7 @@ class NeighborSampler(BaseSampler):
       return
     try:
       from ..obs import get_registry, get_tracer
-      if os.environ.get('GLT_OBS_TABLE_OCCUPANCY', '') not in (
-          '1', 'true'):
+      if not knob('GLT_OBS_TABLE_OCCUPANCY', False):
         t = get_tracer()
         # mirror the tracer's own probabilistic sync draw: reading the
         # count blocks on the walk, so it must happen on the SAMPLED

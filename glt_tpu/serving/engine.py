@@ -170,11 +170,17 @@ class InferenceEngine:
     return self.compile_stats()
 
   def compile_stats(self) -> dict:
-    """Compilation/exec counters for the zero-recompile guarantee."""
+    """Compilation/exec counters for the zero-recompile guarantee.
+
+    Deliberately LOCK-FREE: infer() holds the engine lock across the
+    device forward, so a wedged device would turn every stats scrape
+    into a hang at exactly the moment operators need it (the stall
+    path the watchdog exists for). The counters are GIL-atomic Python
+    ints; a read racing an increment is off by at most one."""
     return {
         'forward_traces': dict(self._trace_counts),
         'sampler_compiled_fns': self.sampler.num_compiled_fns,
-        'forward_calls': self.forward_calls,
+        'forward_calls': self.forward_calls,  # gltlint: disable=GLT002
     }
 
   @property
@@ -240,8 +246,10 @@ class InferenceEngine:
     loop."""
     b = self.buckets[0]
     batch = self.make_batch(np.zeros(b, np.int64), b, b)
-    self.params = self.model.init(rng_key, batch)
-    return self.params
+    params = self.model.init(rng_key, batch)
+    with self._lock:
+      self.params = params
+    return params
 
   def _run_bucket(self, seeds: np.ndarray, n_valid: int,
                   bucket: int) -> np.ndarray:
@@ -329,7 +337,9 @@ class InferenceEngine:
       self.params = params
       if bump_version:
         self.model_version += 1
-    return self.model_version
+      # return the version from THIS swap's lock hold: reading it
+      # after release could observe a concurrent swap's bump (GLT002)
+      return self.model_version
 
   def invalidate(self, ids=None, version=None) -> int:
     """Cache invalidation serialized against in-flight infer (the

@@ -332,8 +332,8 @@ class StreamIngestor:
                   tick_failures=self._tick_failures,
                   tick_errors_total=self.tick_errors_total,
                   restart_policy=self.restart_policy)
-            except Exception:
-              pass
+            except Exception:  # gltlint: disable=GLT006
+              pass  # the recorder itself failed; nothing left to record to
             return
         else:
           self._tick_failures = 0

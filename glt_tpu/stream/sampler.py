@@ -29,7 +29,6 @@ compaction folds them into the CSR).
 from __future__ import annotations
 
 import logging
-import os
 from typing import Optional, Sequence
 
 import jax
@@ -41,6 +40,7 @@ from ..ops.pipeline import edge_hop_offsets, hop_engine, \
     make_dedup_tables, multihop_sample
 from ..sampler.base import BaseSampler, NodeSamplerInput, SamplerOutput
 from ..utils import as_numpy
+from ..utils.env import knob
 from ..utils.rng import RandomSeedManager, make_key
 from .snapshot import SnapshotManager
 
@@ -191,12 +191,13 @@ class StreamSampler(BaseSampler):
         self._fused_fallback_counted = True
         from ..ops.pipeline import count_engine_fallback
         requested = (getattr(self, '_hop_engine_override', None)
-                     or os.environ.get('GLT_HOP_ENGINE', 'auto'))
+                     or knob('GLT_HOP_ENGINE', 'auto'))
         count_engine_fallback(requested, 'pallas', 'stream_overlay')
       eng = 'pallas'
     if eng == 'element' or not any(f > 0 for f in self._base_fanouts):
       return ('element', 0, 0)
-    width = max(int(os.environ.get('GLT_WINDOW_W', '96')), 8)
+    from ..sampler.neighbor_sampler import _window_width
+    width = _window_width()
     slack = int(snap.arrays['indices'].shape[0]) - int(snap.num_edges)
     if slack < width:
       if snap.version != self._window_warned_version:

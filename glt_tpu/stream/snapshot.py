@@ -269,7 +269,10 @@ class SnapshotManager:
     assert buffer.capacity <= self.delta_capacity, (
         f'buffer capacity {buffer.capacity} exceeds the overlay '
         f'capacity {self.delta_capacity} the compiled shapes carry')
-    key = (id(buffer), buffer.mutation_seq, self._current.version)
+    # ONE reference load: key version and build geometry must come from
+    # the same snapshot even if compact() swaps mid-call (GLT002)
+    cur = self._current  # gltlint: disable=GLT002
+    key = (id(buffer), buffer.mutation_seq, cur.version)
     if self._overlay_cache is not None \
         and self._overlay_cache[0] == key:
       return self._overlay_cache[1]
@@ -277,7 +280,7 @@ class SnapshotManager:
     if cut.num_ops == 0:
       self._overlay_cache = (key, self.empty_overlay())
       return self._overlay_cache[1]
-    n = self._current.num_rows
+    n = cur.num_rows
     ip, ix = _delta_csr(cut.ins_src, cut.ins_dst, n,
                         self.delta_capacity, self.layout, self.device)
     dp, dx = _delta_csr(cut.del_src, cut.del_dst, n,

@@ -14,6 +14,8 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+from .env import raw as raw_env
+
 _ENV_VARS = ('GLT_BENCH_PLATFORM', 'GLT_PLATFORM')
 
 
@@ -37,11 +39,11 @@ def force_backend(platform: Optional[str] = None,
   """
   if platform is None:
     for var in _ENV_VARS:
-      if os.environ.get(var):
-        platform = os.environ[var]
+      if raw_env(var):
+        platform = raw_env(var)
         break
   if host_devices is not None:
-    flags = os.environ.get('XLA_FLAGS', '')
+    flags = raw_env('XLA_FLAGS', '')
     if 'xla_force_host_platform_device_count' not in flags:
       os.environ['XLA_FLAGS'] = (
           flags + f' --xla_force_host_platform_device_count'

@@ -9,8 +9,9 @@ placement failures instead of silently degrading to the host phase.
 """
 from __future__ import annotations
 
-import os
 from typing import Optional
+
+from .env import knob
 
 
 def offload_requested(host_offload: Optional[bool],
@@ -18,7 +19,7 @@ def offload_requested(host_offload: Optional[bool],
   """Resolve the tri-state flag: None = auto (on when spilled unless
   GLT_HOST_OFFLOAD=0)."""
   if host_offload is None:
-    return spilled and os.environ.get('GLT_HOST_OFFLOAD', '1') != '0'
+    return spilled and knob('GLT_HOST_OFFLOAD', True)
   return bool(host_offload)
 
 

@@ -8,10 +8,11 @@ a key stream, while the whole run stays reproducible from one seed.
 """
 from __future__ import annotations
 
-import os
 import threading
 
 import jax
+
+from .env import knob
 
 
 def make_key(seed: int) -> jax.Array:
@@ -25,7 +26,7 @@ def make_key(seed: int) -> jax.Array:
   The impl travels inside the typed key, so every ``jax.random.split``
   / ``fold_in`` downstream inherits it.
   """
-  impl = os.environ.get('GLT_PRNG') or None
+  impl = knob('GLT_PRNG', None) or None
   return jax.random.key(int(seed), impl=impl)
 
 
@@ -51,13 +52,18 @@ class RandomSeedManager:
       self._counter = 0
 
   def getSeed(self) -> int:
-    return self._seed
+    with self._local:
+      return self._seed
 
   def nextKey(self) -> jax.Array:
+    # seed and counter must come from ONE lock hold: a setSeed racing
+    # between the counter draw and the seed read would pair the new
+    # seed with the old stream position (gltlint GLT002)
     with self._local:
       c = self._counter
       self._counter += 1
-    return jax.random.fold_in(make_key(self._seed), c)
+      seed = self._seed
+    return jax.random.fold_in(make_key(seed), c)
 
 
 def new_key() -> jax.Array:

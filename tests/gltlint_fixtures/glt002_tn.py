@@ -1,0 +1,62 @@
+"""GLT002 true negatives: every access path is actually safe."""
+import threading
+
+
+class LockedCounter:
+  def __init__(self):
+    self._lock = threading.Lock()
+    self.hits = 0     # __init__ happens-before any thread
+
+  def record(self, n):
+    with self._lock:
+      self.hits += n
+
+  def hit_rate(self):
+    with self._lock:                  # guarded read
+      return self.hits
+
+  def bulk(self, items):
+    with self._lock:
+      for n in items:
+        self._record_locked(n)        # helper only ever called under
+                                      # the lock -> assumed-locked
+
+  def _record_locked(self, n):
+    self.hits += n
+
+  def manual(self):
+    self._lock.acquire()              # hand-rolled protocol: exempt
+    try:
+      self.hits += 1
+    finally:
+      self._lock.release()
+
+
+class ClosureUnderLock:
+  """A def INSIDE the guarded block runs later, without the lock: its
+  store must not count as guarded (no false lock-ownership of _count,
+  so the bare read() stays clean)."""
+
+  def __init__(self):
+    self._lock = threading.Lock()
+    self._count = 0
+    self._cb = None
+
+  def start(self):
+    with self._lock:
+      def cb():
+        self._count += 1    # deferred, lockless — NOT a guarded store
+      self._cb = cb
+
+  def read(self):
+    return self._count
+
+
+class NoLockNoFindings:
+  """No lock in the class at all: attribute access is out of scope."""
+
+  def __init__(self):
+    self.count = 0
+
+  def bump(self):
+    self.count += 1

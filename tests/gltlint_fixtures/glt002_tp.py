@@ -1,0 +1,22 @@
+"""GLT002 true positives: lock-owned attrs touched bare."""
+import threading
+
+
+class TornCounter:
+  """hits is written under the lock, then read AND written bare."""
+
+  def __init__(self):
+    self._lock = threading.Lock()
+    self.hits = 0
+    self.total = 0
+
+  def record(self, n):
+    with self._lock:
+      self.hits += n
+      self.total += n
+
+  def hit_rate(self):
+    return self.hits / max(self.total, 1)    # bare read: finding x2
+
+  def reset(self):
+    self.hits = 0                            # bare write: finding

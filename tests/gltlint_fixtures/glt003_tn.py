@@ -1,0 +1,23 @@
+"""GLT003 true negatives: staged correctly or not traced at all."""
+import jax
+import jax.numpy as jnp
+
+
+class Staging:
+  def build(self):
+    @jax.jit
+    def fwd(x):
+      return x * 2                    # pure: nothing to flag
+    return fwd
+
+  def staged(self):
+    @jax.jit
+    def fwd(x):
+      with jax.ensure_compile_time_eval():
+        self.window = jnp.arange(4)   # sanctioned compile-time staging
+      return x
+    return fwd
+
+  def untraced_mutation(self, x):
+    self.window = jnp.cumsum(x)       # plain method, never jitted
+    return self.window

@@ -1,0 +1,19 @@
+"""GLT003 true positives: instance mutation inside jitted callees."""
+import jax
+import jax.numpy as jnp
+
+
+class Staging:
+  def build(self):
+    @jax.jit
+    def fwd(x):
+      self.window = jnp.cumsum(x)     # rebinds live state to a tracer
+      self.cache[0] = x               # subscript store on self state
+      return x * 2
+    return fwd
+
+  def wrap_site(self):
+    def inner(x):
+      self.latest = x                 # found via jit(inner) below
+      return x + 1
+    return jax.jit(inner)

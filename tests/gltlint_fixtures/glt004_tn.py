@@ -1,0 +1,25 @@
+"""GLT004 true negatives: arrays ride jit ARGUMENTS (the StreamSampler
+contract), and jitted METHODS (self is a parameter) are out of scope."""
+import jax
+import jax.numpy as jnp
+
+TABLE = jnp.arange(1024)
+
+
+class Sampler:
+  def build(self):
+    @jax.jit
+    def fn(seeds, table, weights):
+      return table[seeds] * weights   # everything is an argument
+    return fn
+
+  def run(self, seeds):
+    return self.build()(seeds, TABLE, jnp.ones(1024))
+
+  def method_form(self, seeds):
+    # jitting a bound method: self is a (pytree) parameter, not a free
+    # closure — the recompile story is the instance hash, not a leak
+    return jax.jit(self._fwd)(seeds)
+
+  def _fwd(self, seeds):
+    return seeds * 2

@@ -1,0 +1,22 @@
+"""GLT004 true positives: jitted closures over instance/module arrays."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+TABLE = jnp.arange(1024)              # module-level array
+
+
+class Sampler:
+  def build(self):
+    @jax.jit
+    def fn(seeds):
+      rows = TABLE[seeds]             # closure over the module array
+      return rows * self.weights      # closure over instance state
+    return fn
+
+  def build_partial(self):
+    @functools.partial(jax.jit, static_argnums=0)
+    def fn(k, seeds):
+      return seeds + self.offsets     # @partial(jax.jit, ...) form
+    return fn

@@ -1,0 +1,25 @@
+"""GLT005 true negatives: every sanctioned done-race guard."""
+from concurrent.futures import InvalidStateError
+
+
+def guard_by_done(fut, value):
+  if not fut.done():
+    fut.set_result(value)
+
+
+def guard_by_try(fut, err):
+  try:
+    if not fut.done():
+      fut.set_exception(err)
+  except InvalidStateError:
+    pass  # the other thread resolved it first: that outcome stands
+
+
+def guard_by_handshake(fut, value):
+  if fut.set_running_or_notify_cancel():
+    fut.set_result(value)
+
+
+def guard_by_cancelled(fut, value):
+  if not fut.cancelled():
+    fut.set_result(value)

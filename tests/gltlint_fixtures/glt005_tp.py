@@ -1,0 +1,22 @@
+"""GLT005 true positives: unguarded Future resolution."""
+
+
+def resolve(fut, value):
+  fut.set_result(value)               # no done() guard, no try
+
+
+def fail(req, err):
+  req.future.set_exception(err)       # dotted receiver, same class
+
+
+def conditional_but_wrong(fut, value, ready):
+  if ready:                           # an if, but not a done-race test
+    fut.set_result(value)
+
+
+def resolve_from_handler(fut, work):
+  try:
+    work()
+  except Exception as e:
+    fut.set_exception(e)              # the handler is NOT guarded by
+                                      # its own try: the watchdog race

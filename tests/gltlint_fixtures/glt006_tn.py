@@ -1,0 +1,36 @@
+"""GLT006 true negatives: handlers that surface, plus non-thread code."""
+import logging
+import queue
+import threading
+
+logger = logging.getLogger(__name__)
+
+
+class Worker:
+  def start(self):
+    self._t = threading.Thread(target=self._loop, daemon=True)
+    self._t.start()
+
+  def _loop(self):
+    while True:
+      try:
+        self._tick()
+      except queue.Empty:
+        continue                      # expected sentinel: control flow
+      except Exception as e:
+        self._last_error = e          # recorded to state
+        logger.exception('tick failed')
+
+  def _tick(self):
+    raise NotImplementedError
+
+
+def not_a_thread_target():
+  try:
+    risky()
+  except Exception:
+    pass                              # sync caller sees the fallout
+
+
+def risky():
+  raise ValueError

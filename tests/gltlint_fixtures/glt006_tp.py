@@ -1,0 +1,31 @@
+"""GLT006 true positives: silent swallows inside thread targets."""
+import threading
+
+
+class Worker:
+  def start(self):
+    self._t = threading.Thread(target=self._loop, daemon=True)
+    self._t.start()
+
+  def _loop(self):
+    while True:
+      try:
+        self._tick()
+      except Exception:
+        pass                          # invisible until the stall
+
+  def _tick(self):
+    raise NotImplementedError
+
+
+def submitted(pool):
+  def job():
+    try:
+      risky()
+    except ValueError:
+      pass                            # swallowed in an executor job
+  pool.submit(job)
+
+
+def risky():
+  raise ValueError

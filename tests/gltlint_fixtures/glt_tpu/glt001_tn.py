@@ -1,0 +1,21 @@
+"""GLT001 true negatives: knob()/raw() reads and environ WRITES."""
+import os
+
+from glt_tpu.utils.env import knob, raw
+
+
+def through_knob():
+  return knob('GLT_FIXTURE_KNOB', 8)
+
+
+def through_raw():
+  return raw('XLA_FLAGS', '')
+
+
+def writes_are_legal():
+  os.environ.setdefault('XLA_FLAGS', '')
+  os.environ['GLT_FIXTURE_CHILD'] = '1'
+
+
+def suppressed_read():
+  return os.environ.get('GLT_FIXTURE_KNOB')  # gltlint: disable=GLT001

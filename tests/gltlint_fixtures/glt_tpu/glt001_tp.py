@@ -1,0 +1,19 @@
+"""GLT001 true positives: every flavor of raw environ read."""
+import os
+from os import environ, getenv
+
+
+def numeric_parse():
+  return int(os.environ.get('GLT_FIXTURE_KNOB', '8'))
+
+
+def subscript_read():
+  return os.environ['GLT_FIXTURE_KNOB']
+
+
+def via_getenv():
+  return getenv('GLT_FIXTURE_KNOB')
+
+
+def via_imported_environ():
+  return environ.get('GLT_FIXTURE_KNOB')

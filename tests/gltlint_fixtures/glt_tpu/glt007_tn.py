@@ -1,0 +1,13 @@
+"""GLT007 true negatives: cataloged names and non-literal names."""
+from glt_tpu.utils.env import knob
+
+
+def read_knob():
+  return knob('GLT_DOCUMENTED_KNOB', 1)
+
+
+def register(registry, dynamic_name):
+  registry.counter('documented_metric_total').inc()
+  registry.counter(dynamic_name).inc()    # runtime name: out of scope
+  options = {'not_a_metric': 1}           # plain dict key, no registry
+  return options

@@ -1,0 +1,18 @@
+"""GLT007 true positives: undocumented knob + metric."""
+from glt_tpu.utils.env import knob
+
+
+def read_knob():
+  return knob('GLT_UNDOCUMENTED_KNOB', 1)
+
+
+def read_substring_knob():
+  # a PREFIX of the documented GLT_DOCUMENTED_KNOB: substring luck
+  # must not count as documentation
+  return knob('GLT_DOCUMENTED', 1)
+
+
+def register(registry):
+  registry.counter('metric_missing_from_docs_total').inc()
+  registry.gauge('gauge_missing_from_docs').set(1.0)
+  registry.counter('documented_metric').inc()   # prefix of _total row

@@ -1,0 +1,15 @@
+"""GLT008 true negatives: narrow planes, and a justified widening."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def narrow_indices(idx):
+  slots = idx.astype(jnp.int32)
+  feats = np.zeros(8, dtype=np.float32)
+  picks = idx.astype('int32')
+  return slots, feats, picks
+
+
+def justified_widening(idx):
+  # host-side accumulation across the whole epoch genuinely needs i64
+  return idx.astype(np.int64)  # gltlint: disable=GLT008

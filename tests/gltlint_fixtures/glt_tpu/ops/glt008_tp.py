@@ -1,0 +1,10 @@
+"""GLT008 true positives: 64-bit planes in an ops/ hot path."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def widen_indices(idx):
+  wide = idx.astype(jnp.int64)          # attribute form
+  host = np.zeros(8, dtype=np.float64)  # np attribute form
+  named = idx.astype('int64')           # string dtype form
+  return wide, host, named

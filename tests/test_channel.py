@@ -296,8 +296,8 @@ def test_shm_channel_multi_producer_multi_consumer():
               with lock:
                 got.append((int(msg['pid'][0]), int(msg['i'][0]),
                             msg['data'].copy()))
-          except Exception:
-            pass
+          except Exception:  # gltlint: disable=GLT006
+            pass  # drain runs until recv times out: that IS the exit
           return
         with lock:
           got.append((int(msg['pid'][0]), int(msg['i'][0]),

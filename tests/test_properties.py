@@ -6,7 +6,12 @@ strategy, SURVEY.md §4)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+hypothesis = pytest.importorskip(
+    'hypothesis', reason='property tests need hypothesis (optional '
+    'test dependency; the fixture-exact tests cover the same paths)')
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from glt_tpu.ops.sample import sample_full_neighbors, sample_neighbors
 from glt_tpu.ops.unique import (

@@ -1,0 +1,25 @@
+"""gltlint — stdlib-``ast`` static analysis for glt_tpu's own invariants.
+
+Every rule encodes a bug class this repo has already paid for at least
+once (see docs/static_analysis.md for the provenance of each):
+
+  GLT001  raw os.environ parse outside glt_tpu.utils.env (import crash)
+  GLT002  guarded-by violation: attr written under a lock, touched bare
+  GLT003  trace-time staging: instance mutation inside a jitted callee
+  GLT004  jit closure over instance/module arrays (recompile hazard)
+  GLT005  Future.set_result/set_exception without a done-race guard
+  GLT006  silent except swallow inside a thread/background target
+  GLT007  docs drift: metric / GLT_* knob missing from the doc catalogs
+  GLT008  int64/float64 planes in ops/ hot paths (narrowing audit)
+
+Usage::
+
+  python -m tools.gltlint glt_tpu/ [tools/ tests/] [--json out.json]
+
+Findings not present in the checked-in baseline
+(tools/gltlint/baseline.json) fail the run; inline
+``# gltlint: disable=GLT00x`` comments suppress a single line.
+"""
+from .core import Finding, Rule, all_rules, lint_paths  # noqa: F401
+
+__version__ = '0.1.0'

@@ -1,0 +1,140 @@
+"""Command-line front end: ``python -m tools.gltlint [paths...]``.
+
+Exit codes: 0 = clean (all findings baselined or none), 1 = new
+findings or parse errors, 2 = usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+from .core import (
+    all_rules, find_root, lint_paths, load_baseline, write_baseline,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+  p = argparse.ArgumentParser(
+      prog='python -m tools.gltlint',
+      description='glt_tpu invariant linter (see docs/static_analysis.md)')
+  p.add_argument('paths', nargs='*', default=['glt_tpu/'],
+                 help='files or directories to lint (default: glt_tpu/)')
+  p.add_argument('--root', default=None,
+                 help='project root (default: auto-detect via setup.py/.git)')
+  p.add_argument('--baseline', default=None,
+                 help='baseline JSON (default: tools/gltlint/baseline.json '
+                      'under the root); findings listed there are reported '
+                      'but do not fail the run')
+  p.add_argument('--no-baseline', action='store_true',
+                 help='ignore the baseline: every finding fails the run')
+  p.add_argument('--write-baseline', action='store_true',
+                 help='rewrite the baseline from the current findings '
+                      '(keeps existing justifications)')
+  p.add_argument('--select', default=None, metavar='GLT001,GLT002',
+                 help='comma-separated rule codes to run (default: all)')
+  p.add_argument('--json', dest='json_out', default=None, metavar='PATH',
+                 help='also write findings as JSON (machine-readable, '
+                      'uploaded as the CI artifact)')
+  p.add_argument('--quiet', action='store_true',
+                 help='print only the summary line')
+  p.add_argument('--list-rules', action='store_true',
+                 help='print the rule catalog and exit')
+  return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+  args = build_parser().parse_args(argv)
+  if args.list_rules:
+    for rule in all_rules():
+      codes = '/'.join(getattr(rule, 'codes', None) or (rule.code,))
+      scope = ','.join(rule.applies_to) or '<all>'
+      print(f'{codes:16s} {rule.name:28s} scope={scope}')
+    return 0
+
+  t0 = time.perf_counter()
+  first = args.paths[0]
+  root = args.root or find_root(
+      first if os.path.isdir(first) else os.path.dirname(first) or '.')
+  baseline_path = args.baseline or os.path.join(
+      root, 'tools', 'gltlint', 'baseline.json')
+  baseline = {} if args.no_baseline else load_baseline(baseline_path)
+  select = (set(c.strip() for c in args.select.split(','))
+            if args.select else None)
+
+  result = lint_paths(args.paths, root=root, select=select,
+                      baseline=baseline)
+
+  dt = time.perf_counter() - t0
+  if args.json_out:
+    payload = {
+        'new': [f.as_dict() for f in result.findings],
+        'baselined': [f.as_dict() for f in result.baselined],
+        'errors': result.errors,
+        'elapsed_s': round(dt, 3),
+    }
+    with open(args.json_out, 'w', encoding='utf-8') as fh:
+      json.dump(payload, fh, indent=2)
+      fh.write('\n')
+
+  if args.write_baseline:
+    if select is not None:
+      # a partial rule set would rewrite the file WITHOUT the other
+      # rules' entries, losing their hand-written justifications
+      print('--write-baseline requires the full rule set: drop '
+            '--select and rerun')
+      return 2
+    if result.errors:
+      # an unparsable/missing input means the baseline would silently
+      # omit its findings — refuse rather than write an incomplete one
+      for err in result.errors:
+        print(f'ERROR {err}')
+      print('baseline NOT written: fix the errors above first')
+      return 1
+    # entries for files outside the linted paths were not re-checked:
+    # carry them over verbatim instead of silently dropping them
+    lint_dirs = [os.path.abspath(p) for p in args.paths]
+    def outside_scope(key: str) -> bool:
+      parts = key.split('::')
+      target = os.path.join(root, parts[1]) if len(parts) > 1 else ''
+      return not any(
+          target == d or target.startswith(d.rstrip(os.sep) + os.sep)
+          for d in lint_dirs)
+    carry = {k: j for k, j in baseline.items() if outside_scope(k)}
+    write_baseline(baseline_path,
+                   result.findings + result.baselined,
+                   old=baseline, carry=carry)
+    print(f'wrote baseline to {os.path.relpath(baseline_path, root)} '
+          f'({len(result.findings) + len(result.baselined)} observed, '
+          f'{len(carry)} carried from outside the linted paths)')
+    # every entry needs a REAL justification: exit nonzero while any
+    # placeholder remains, so a rebaseline can't silently grandfather
+    # a new violation behind a green exit code
+    todos = [k for k, j in load_baseline(baseline_path).items()
+             if j == 'TODO: justify or fix']
+    if todos:
+      for k in todos:
+        print(f'NEEDS JUSTIFICATION {k}')
+      print(f'{len(todos)} entr{"y" if len(todos) == 1 else "ies"} '
+            'carry the TODO placeholder: justify each (or fix the '
+            'code) before committing the baseline')
+      return 1
+    return 0
+
+  if not args.quiet:
+    for f in result.findings:
+      print(f.render())
+    for err in result.errors:
+      print(f'ERROR {err}')
+  print(f'gltlint: {len(result.findings)} new finding(s), '
+        f'{len(result.baselined)} baselined, '
+        f'{len(result.errors)} error(s) in {dt:.2f}s')
+
+  return 0 if result.ok else 1
+
+
+if __name__ == '__main__':
+  sys.exit(main())

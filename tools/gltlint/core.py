@@ -1,0 +1,294 @@
+"""Rule framework: file contexts, suppressions, baseline, runner.
+
+Design constraints:
+  * stdlib only (``ast`` + ``tokenize``) — the lint job must run before
+    any dependency install and inside the sdist.
+  * Baseline keys are line-number-free (rule + path + scope + token) so
+    unrelated edits above a grandfathered finding don't churn the file.
+  * Rules are registered by subclassing :class:`Rule`; each declares the
+    path scope it applies to, so running the CLI over ``tests/`` doesn't
+    drown the signal in fixture noise.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+_SUPPRESS_TAG = 'gltlint:'
+
+#: directories never collected when walking a tree (explicit file
+#: arguments bypass this — the fixture tests lint them directly)
+_SKIP_DIRS = {'__pycache__', '.git', 'gltlint_fixtures', 'build',
+              '.pytest_cache', 'node_modules'}
+
+
+@dataclass(frozen=True)
+class Finding:
+  rule: str          # 'GLT001'
+  path: str          # root-relative posix path
+  line: int
+  col: int
+  scope: str         # dotted context, e.g. 'Tracer.__init__'
+  token: str         # stable discriminator (env var, attr name, ...)
+  message: str
+
+  @property
+  def key(self) -> str:
+    """Line-free identity used for baselining."""
+    return f'{self.rule}::{self.path}::{self.scope}::{self.token}'
+
+  def render(self) -> str:
+    where = f' [{self.scope}]' if self.scope else ''
+    return (f'{self.path}:{self.line}:{self.col}: {self.rule}'
+            f'{where} {self.message}')
+
+  def as_dict(self) -> dict:
+    return {
+        'rule': self.rule, 'path': self.path, 'line': self.line,
+        'col': self.col, 'scope': self.scope, 'token': self.token,
+        'message': self.message, 'key': self.key,
+    }
+
+
+class FileCtx:
+  """Parsed source + per-line suppression table for one file."""
+
+  def __init__(self, abspath: str, relpath: str, source: str):
+    self.abspath = abspath
+    self.relpath = relpath.replace(os.sep, '/')
+    self.source = source
+    self.tree = ast.parse(source, filename=abspath)
+    self.file_disabled: Set[str] = set()
+    # line -> set of rule codes disabled on that line
+    self.line_disabled: Dict[int, Set[str]] = {}
+    self._parse_suppressions()
+
+  def _parse_suppressions(self) -> None:
+    try:
+      toks = tokenize.generate_tokens(io.StringIO(self.source).readline)
+      comments = [(t.start[0], t.string) for t in toks
+                  if t.type == tokenize.COMMENT]
+    except tokenize.TokenError:
+      comments = []
+    for line, text in comments:
+      body = text.lstrip('#').strip()
+      if not body.startswith(_SUPPRESS_TAG):
+        continue
+      directive = body[len(_SUPPRESS_TAG):].strip()
+      for clause in directive.split(';'):
+        clause = clause.strip()
+        if clause.startswith('disable-file='):
+          self.file_disabled |= _codes(clause[len('disable-file='):])
+        elif clause.startswith('disable-next='):
+          self.line_disabled.setdefault(line + 1, set()).update(
+              _codes(clause[len('disable-next='):]))
+        elif clause.startswith('disable='):
+          self.line_disabled.setdefault(line, set()).update(
+              _codes(clause[len('disable='):]))
+
+  def suppressed(self, finding: Finding) -> bool:
+    if finding.rule in self.file_disabled or 'all' in self.file_disabled:
+      return True
+    on_line = self.line_disabled.get(finding.line, ())
+    return finding.rule in on_line or 'all' in on_line
+
+
+def _codes(spec: str) -> Set[str]:
+  return {c.strip() for c in spec.split(',') if c.strip()}
+
+
+class ProjectCtx:
+  """Cross-file context: project root + lazily-read doc catalogs."""
+
+  DOC_CATALOGS = ('docs/observability.md', 'docs/performance.md')
+
+  def __init__(self, root: str):
+    self.root = os.path.abspath(root)
+    self._docs: Optional[str] = None
+
+  def doc_text(self) -> str:
+    if self._docs is None:
+      parts = []
+      for rel in self.DOC_CATALOGS:
+        p = os.path.join(self.root, rel)
+        if os.path.exists(p):
+          with open(p, encoding='utf-8') as f:
+            parts.append(f.read())
+      self._docs = '\n'.join(parts)
+    return self._docs
+
+
+class Rule:
+  """Base class. Subclass, set ``code``/``name``/``applies_to``,
+  implement :meth:`check`. Subclasses self-register."""
+
+  code: str = ''
+  name: str = ''
+  #: root-relative posix path prefixes this rule runs on ((),) = all
+  applies_to: Tuple[str, ...] = ()
+  #: path prefixes this rule never runs on
+  excludes: Tuple[str, ...] = ()
+
+  _registry: List[type] = []
+
+  def __init_subclass__(cls, **kw):
+    super().__init_subclass__(**kw)
+    if cls.code:
+      Rule._registry.append(cls)
+
+  def applies(self, relpath: str) -> bool:
+    if any(relpath.startswith(p) for p in self.excludes):
+      return False
+    return (not self.applies_to
+            or any(relpath.startswith(p) for p in self.applies_to))
+
+  def check(self, ctx: FileCtx, project: ProjectCtx) -> Iterator[Finding]:
+    raise NotImplementedError
+
+  # -- helpers shared by rules -------------------------------------------
+
+  @staticmethod
+  def dotted(node: ast.AST) -> str:
+    """'a.b.c' for nested Name/Attribute chains, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+      parts.append(node.attr)
+      node = node.value
+    if isinstance(node, ast.Name):
+      parts.append(node.id)
+      return '.'.join(reversed(parts))
+    return ''
+
+
+def all_rules() -> List[Rule]:
+  # importing the rules package populates the registry
+  from . import rules  # noqa: F401
+  return [cls() for cls in Rule._registry]
+
+
+def find_root(start: str) -> str:
+  """Walk up from ``start`` to the repo root (setup.py/.git marker)."""
+  cur = os.path.abspath(start)
+  while True:
+    if (os.path.exists(os.path.join(cur, 'setup.py'))
+        or os.path.exists(os.path.join(cur, '.git'))):
+      return cur
+    parent = os.path.dirname(cur)
+    if parent == cur:
+      return os.path.abspath(start)
+    cur = parent
+
+
+def collect_files(paths: Iterable[str], root: str) -> List[str]:
+  out: List[str] = []
+  for p in paths:
+    p = os.path.abspath(p)
+    if os.path.isfile(p):
+      out.append(p)       # explicit files always lint (fixtures too)
+      continue
+    for dirpath, dirnames, filenames in os.walk(p):
+      dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+      for fn in sorted(filenames):
+        if fn.endswith('.py'):
+          out.append(os.path.join(dirpath, fn))
+  seen: Set[str] = set()
+  uniq = []
+  for p in out:
+    if p not in seen:
+      seen.add(p)
+      uniq.append(p)
+  return uniq
+
+
+@dataclass
+class LintResult:
+  findings: List[Finding] = field(default_factory=list)     # new
+  baselined: List[Finding] = field(default_factory=list)    # known
+  errors: List[str] = field(default_factory=list)           # parse/etc.
+
+  @property
+  def ok(self) -> bool:
+    return not self.findings and not self.errors
+
+
+def load_baseline(path: str) -> Dict[str, str]:
+  """baseline.json -> {finding key: justification}."""
+  if not path or not os.path.exists(path):
+    return {}
+  with open(path, encoding='utf-8') as f:
+    data = json.load(f)
+  out: Dict[str, str] = {}
+  for entry in data.get('findings', []):
+    out[entry['key']] = entry.get('justification', '')
+  return out
+
+
+def write_baseline(path: str, findings: List[Finding],
+                   old: Optional[Dict[str, str]] = None,
+                   carry: Optional[Dict[str, str]] = None) -> None:
+  """``carry`` = old entries for files OUTSIDE the run's scope: they
+  were not re-checked, so they keep their grandfathering verbatim."""
+  old = old or {}
+  merged: Dict[str, str] = dict(carry or {})
+  for f in findings:
+    if f.key not in merged:     # several lines can share one key
+      merged[f.key] = old.get(f.key, 'TODO: justify or fix')
+  entries = [{'key': k, 'justification': merged[k]}
+             for k in sorted(merged)]
+  payload = {
+      'comment': ('Grandfathered gltlint findings. Every entry needs a '
+                  'one-line justification; remove entries as the code '
+                  'is fixed. New findings are NOT auto-added here.'),
+      'findings': entries,
+  }
+  with open(path, 'w', encoding='utf-8') as f:
+    json.dump(payload, f, indent=2, sort_keys=False)
+    f.write('\n')
+
+
+def lint_paths(paths: Iterable[str], root: Optional[str] = None,
+               select: Optional[Set[str]] = None,
+               baseline: Optional[Dict[str, str]] = None) -> LintResult:
+  """Run every (selected) rule over every file under ``paths``."""
+  paths = list(paths)        # callers may pass a one-shot iterator
+  first = paths[0] if paths else '.'
+  root = root or find_root(first if os.path.isdir(first)
+                           else os.path.dirname(first) or '.')
+  project = ProjectCtx(root)
+  rules = [r for r in all_rules()
+           if select is None
+           or select & set(getattr(r, 'codes', None) or (r.code,))]
+  baseline = baseline or {}
+  result = LintResult()
+  for p in paths:
+    if not os.path.exists(p):
+      # a typo'd/renamed path must FAIL the gate, not go vacuously green
+      result.errors.append(f'{p}: path does not exist')
+  for abspath in collect_files(paths, root):
+    relpath = os.path.relpath(abspath, root).replace(os.sep, '/')
+    try:
+      with open(abspath, encoding='utf-8') as f:
+        source = f.read()
+      ctx = FileCtx(abspath, relpath, source)
+    except (OSError, SyntaxError, ValueError) as e:
+      result.errors.append(f'{relpath}: {e!r}')
+      continue
+    for rule in rules:
+      if not rule.applies(relpath):
+        continue
+      for finding in rule.check(ctx, project):
+        if select is not None and finding.rule not in select:
+          continue     # multi-code rules (GLT003/GLT004) half-selected
+        if ctx.suppressed(finding):
+          continue
+        if finding.key in baseline:
+          result.baselined.append(finding)
+        else:
+          result.findings.append(finding)
+  result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+  return result

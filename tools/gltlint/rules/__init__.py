@@ -1,0 +1,10 @@
+"""Importing this package registers every rule with the framework."""
+from . import (  # noqa: F401
+    docs_drift,
+    dtype_width,
+    env_knobs,
+    futures,
+    guarded_by,
+    thread_except,
+    trace_staging,
+)

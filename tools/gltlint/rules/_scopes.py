@@ -1,0 +1,40 @@
+"""Shared helper: map an AST node to its dotted scope ('Cls.method').
+
+Builds (and caches per-tree) a node -> enclosing-scope table in one
+walk, so rules can report *where* a finding lives and baseline keys
+survive line-number drift.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict
+
+# one-entry cache: hold the tree OBJECT (not id(tree) — ids recycle
+# after gc, which could serve a stale table to a new tree)
+_cached_tree: ast.AST = None
+_cached_table: Dict[int, str] = {}
+
+
+def _build(tree: ast.AST) -> Dict[int, str]:
+  table: Dict[int, str] = {}
+
+  def visit(node: ast.AST, scope: str) -> None:
+    for child in ast.iter_child_nodes(node):
+      child_scope = scope
+      if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+        child_scope = f'{scope}.{child.name}' if scope else child.name
+      table[id(child)] = child_scope
+      visit(child, child_scope)
+
+  table[id(tree)] = ''
+  visit(tree, '')
+  return table
+
+
+def scope_of(tree: ast.AST, node: ast.AST) -> str:
+  global _cached_tree, _cached_table
+  if tree is not _cached_tree:
+    _cached_tree = tree
+    _cached_table = _build(tree)
+  return _cached_table.get(id(node), '')

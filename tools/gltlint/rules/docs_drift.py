@@ -1,0 +1,81 @@
+"""GLT007 — docs drift: every metric and ``GLT_*`` knob is cataloged.
+
+Bug class: docs/observability.md and docs/performance.md carry the
+knob + metric catalogs operators actually read; every PR that added a
+counter or a knob without touching them made the catalogs a little
+more wrong. This rule makes the contract mechanical: a ``GLT_*``
+string literal or a literal metric name registered on the
+MetricsRegistry anywhere under ``glt_tpu/`` must appear in at least
+one of the two catalog documents.
+
+Only literal names are checked (f-strings and variables pass — the
+registry labels them at runtime); that keeps the rule exact on the
+95% case instead of fuzzy on all of them.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ..core import FileCtx, Finding, ProjectCtx, Rule
+from ._scopes import scope_of
+
+_KNOB = re.compile(r'^GLT_[A-Z0-9_]+$')
+_METRIC = re.compile(r'^[a-z][a-z0-9_]{3,}$')
+_REGISTER = {'counter', 'gauge', 'histogram'}
+_REGISTER_ON_REG = {'inc', 'set', 'observe', 'add'}
+
+
+def _documented(name: str, docs: str) -> bool:
+  """Boundary-aware containment: 'GLT_BENCH' must NOT count as
+  documented just because 'GLT_BENCH_PLATFORM' has a catalog row, and
+  'documented_metric' must not ride 'documented_metric_total'."""
+  return re.search(
+      r'(?<![A-Za-z0-9_])' + re.escape(name) + r'(?![A-Za-z0-9_])',
+      docs) is not None
+
+
+class DocsDriftRule(Rule):
+  code = 'GLT007'
+  name = 'docs-drift'
+  applies_to = ('glt_tpu/',)
+
+  def check(self, ctx: FileCtx, project: ProjectCtx) -> Iterator[Finding]:
+    docs = project.doc_text()
+    if not docs:
+      return       # no catalogs in this tree (fixture corpus runs)
+    for node in ast.walk(ctx.tree):
+      if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+          and _KNOB.match(node.value) and not _documented(node.value,
+                                                         docs):
+        yield Finding(
+            rule=self.code, path=ctx.relpath, line=node.lineno,
+            col=node.col_offset, scope=scope_of(ctx.tree, node),
+            token=node.value,
+            message=(f'knob {node.value!r} is not in the '
+                     'docs/observability.md / docs/performance.md '
+                     'catalogs — document it where operators look'))
+      elif isinstance(node, ast.Call) and \
+          isinstance(node.func, ast.Attribute):
+        attr = node.func.attr
+        receiver = Rule.dotted(node.func.value).lower()
+        registers = (attr in _REGISTER
+                     or (attr in _REGISTER_ON_REG and 'reg' in receiver))
+        if not registers or not node.args:
+          continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)
+                and _METRIC.match(first.value)):
+          continue
+        if _documented(first.value, docs):
+          continue
+        yield Finding(
+            rule=self.code, path=ctx.relpath, line=first.lineno,
+            col=first.col_offset, scope=scope_of(ctx.tree, node),
+            token=first.value,
+            message=(f'metric {first.value!r} is registered but absent '
+                     'from the docs/observability.md / '
+                     'docs/performance.md catalogs — add it to the '
+                     'metrics table'))

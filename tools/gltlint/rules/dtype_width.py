@@ -1,0 +1,44 @@
+"""GLT008 — 64-bit index/pick planes in ``ops/`` hot paths.
+
+Bug class: the PR 12 narrowing audit — int64 slot planes and float64
+accumulators silently double HBM traffic and defeat the VMEM budget of
+the fused kernels. TPU-native code keeps index/pick planes int32 and
+feature math float32/bf16; any deliberate 64-bit use in ops/ carries a
+``# gltlint: disable=GLT008`` with its reason.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import FileCtx, Finding, ProjectCtx, Rule
+from ._scopes import scope_of
+
+_WIDE = {'int64', 'float64', 'uint64'}
+
+
+class DtypeWidthRule(Rule):
+  code = 'GLT008'
+  name = 'wide-dtype-in-ops'
+  applies_to = ('glt_tpu/ops/',)
+
+  def check(self, ctx: FileCtx, project: ProjectCtx) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+      token = None
+      if isinstance(node, ast.Attribute) and node.attr in _WIDE:
+        base = Rule.dotted(node.value)
+        if base in ('jnp', 'np', 'jax.numpy', 'numpy', 'dtypes'):
+          token = f'{base}.{node.attr}'
+      elif isinstance(node, ast.Constant) \
+          and isinstance(node.value, str) and node.value in _WIDE:
+        token = repr(node.value)
+      if token is None:
+        continue
+      yield Finding(
+          rule=self.code, path=ctx.relpath, line=node.lineno,
+          col=node.col_offset, scope=scope_of(ctx.tree, node),
+          token=token,
+          message=(f'{token} in an ops/ hot path: index/pick planes are '
+                   'int32 and feature math float32/bf16 on TPU (PR 12 '
+                   'narrowing audit); widen only with a justified '
+                   'disable comment'))

@@ -1,0 +1,62 @@
+"""GLT001 — raw ``os.environ`` reads outside glt_tpu.utils.env.
+
+Bug class: a malformed knob value (``GLT_OBS_BUFFER=zillion``) turning
+``int(os.environ.get(...))`` into an exception at import time, killing
+``import glt_tpu`` for the whole process (paid for in PR 6 and again in
+PR 11). All reads must route through ``glt_tpu.utils.env.knob()`` (typed
+parse, warn-and-default) or ``glt_tpu.utils.env.raw()`` (string
+passthrough for infra vars). Writes (``setdefault``/item-assign) stay
+legal: they configure child processes, they cannot crash a parse.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import FileCtx, Finding, ProjectCtx, Rule
+from ._scopes import scope_of
+
+
+def _is_environ(node: ast.AST) -> bool:
+  """os.environ / environ (imported from os)."""
+  return (Rule.dotted(node) in ('os.environ', 'environ'))
+
+
+class EnvKnobRule(Rule):
+  code = 'GLT001'
+  name = 'raw-environ-read'
+  applies_to = ('glt_tpu/',)
+  excludes = ('glt_tpu/utils/env.py',)
+
+  def check(self, ctx: FileCtx, project: ProjectCtx) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+      hit = None     # (node-for-location, env var token)
+      if isinstance(node, ast.Call):
+        fn = node.func
+        if (isinstance(fn, ast.Attribute) and fn.attr == 'get'
+            and _is_environ(fn.value)):
+          hit = (node, _literal_name(node.args))
+        elif Rule.dotted(fn) in ('os.getenv', 'getenv'):
+          hit = (node, _literal_name(node.args))
+      elif (isinstance(node, ast.Subscript)
+            and isinstance(node.ctx, ast.Load)
+            and _is_environ(node.value)):
+        hit = (node, _literal_name([node.slice]))
+      if hit is None:
+        continue
+      loc, var = hit
+      yield Finding(
+          rule=self.code, path=ctx.relpath, line=loc.lineno,
+          col=loc.col_offset, scope=scope_of(ctx.tree, loc),
+          token=var,
+          message=(f'raw os.environ read of {var!r}: route through '
+                   'glt_tpu.utils.env.knob() (typed, warn-and-default) '
+                   'or env.raw() so a malformed value cannot crash '
+                   'import'))
+
+
+def _literal_name(args) -> str:
+  if args and isinstance(args[0], ast.Constant) \
+      and isinstance(args[0].value, str):
+    return args[0].value
+  return '<dynamic>'

@@ -1,0 +1,106 @@
+"""GLT005 — ``Future.set_result``/``set_exception`` without a done guard.
+
+Bug class: the watchdog-vs-dispatcher race in serving/batcher.py — two
+threads resolving the same Future; ``done()`` + ``set_*`` is not
+atomic, so the loser raises ``InvalidStateError``, and an exception
+escaping a watchdog thread kills it silently, permanently disabling
+stall protection. The sanctioned idiom (batcher._fail_future) is::
+
+  try:
+    if not fut.done():
+      fut.set_exception(err)
+  except InvalidStateError:
+    pass   # the other thread resolved it first
+
+A ``set_*`` call passes the lint when ANY enclosing ``if``/``while``
+tests ``.done()`` / ``.cancelled()`` / ``set_running_or_notify_cancel``
+or an enclosing ``try`` catches InvalidStateError; single-resolver
+call sites that need neither belong in the baseline with the reason
+the race cannot happen.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List
+
+from ..core import FileCtx, Finding, ProjectCtx, Rule
+from ._scopes import scope_of
+
+_GUARD_ATTRS = {'done', 'cancelled', 'set_running_or_notify_cancel'}
+
+
+def _parents(tree: ast.AST) -> Dict[int, ast.AST]:
+  table: Dict[int, ast.AST] = {}
+  for node in ast.walk(tree):
+    for child in ast.iter_child_nodes(node):
+      table[id(child)] = node
+  return table
+
+
+def _test_has_guard(test: ast.AST) -> bool:
+  for n in ast.walk(test):
+    if isinstance(n, ast.Attribute) and n.attr in _GUARD_ATTRS:
+      return True
+  return False
+
+
+def _catches_invalid_state(handlers: List[ast.ExceptHandler]) -> bool:
+  for h in handlers:
+    if h.type is None:
+      return True          # bare except swallows the race too (GLT006's
+    for n in ast.walk(h.type):       # problem, not this rule's)
+      name = getattr(n, 'attr', getattr(n, 'id', ''))
+      if name in ('InvalidStateError', 'Exception', 'BaseException'):
+        return True
+  return False
+
+
+class FutureGuardRule(Rule):
+  code = 'GLT005'
+  name = 'unguarded-future-resolve'
+
+  def check(self, ctx: FileCtx, project: ProjectCtx) -> Iterator[Finding]:
+    parents = _parents(ctx.tree)
+    for node in ast.walk(ctx.tree):
+      if not (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr in ('set_result', 'set_exception')):
+        continue
+      receiver = Rule.dotted(node.func.value) or '<expr>'
+      # asyncio loop.call_soon_threadsafe style wrappers pass the
+      # bound method, not a call — only direct calls land here.
+      if self._guarded(node, parents):
+        continue
+      yield Finding(
+          rule=self.code, path=ctx.relpath, line=node.lineno,
+          col=node.col_offset, scope=scope_of(ctx.tree, node),
+          token=f'{receiver}.{node.func.attr}',
+          message=(f'{receiver}.{node.func.attr}() without a done-race '
+                   'guard: a second resolver raises InvalidStateError '
+                   'and kills the losing thread (watchdog/dispatcher '
+                   'race, serving/batcher._fail_future is the idiom)'))
+
+  @staticmethod
+  def _guarded(node: ast.AST, parents: Dict[int, ast.AST]) -> bool:
+    cur = node
+    while True:
+      parent = parents.get(id(cur))
+      if parent is None:
+        return False
+      if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+        return False
+      if isinstance(parent, (ast.If, ast.While)) and \
+          _test_has_guard(parent.test):
+        # either branch counts: `if not f.done(): resolve` and
+        # `if f.done(): return / else: resolve` are both the guard
+        return True
+      if isinstance(parent, ast.Try) and \
+          any(cur is stmt for stmt in parent.body) and \
+          _catches_invalid_state(parent.handlers):
+        # only the try BODY is protected by its handlers: a resolve
+        # INSIDE an except/else/finally (`except Exception:
+        # fut.set_exception(e)`) is the unguarded watchdog race
+        # itself, not a guarded call
+        return True
+      cur = parent

@@ -1,0 +1,205 @@
+"""GLT002 — guarded-by inference: torn reads of lock-owned attributes.
+
+Bug class: an attribute consistently written under ``with self._lock:``
+in one method and then read (or written) bare in another — the
+EmbeddingCache.hit_rate (PR 3), ServingMetrics.qps (PR 6) and
+HistogramMetric.count_and_above / SloBurnEvaluator._last (PR 11) torn
+reads, each found in review after shipping.
+
+Inference, per class:
+  1. lock attrs  = names used as ``with self.X:`` or assigned a
+     ``threading.Lock/RLock/Condition/Semaphore`` in the class.
+  2. an attr is *lock-owned* if any method stores to it under a lock.
+  3. every bare access (load or store) of a lock-owned attr is a
+     finding — except in ``__init__``/``__new__``/``__del__``
+     (happens-before construction/teardown), in methods that manually
+     ``self.X.acquire()`` (assumed hand-rolled locking), and in private
+     helpers whose every intra-class call site is itself under the lock
+     (computed to fixpoint).
+
+Benign bare accesses (GIL-atomic reference swaps, single-writer stats)
+belong in the baseline with a justification, not silently unflagged.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Set, Tuple
+
+from ..core import FileCtx, Finding, ProjectCtx, Rule
+from ._scopes import scope_of
+
+_LOCKISH_NAME = re.compile(r'(lock|mutex|cond)', re.IGNORECASE)
+_LOCK_CTORS = {'Lock', 'RLock', 'Condition', 'Semaphore',
+               'BoundedSemaphore'}
+_EXEMPT_METHODS = {'__init__', '__new__', '__del__'}
+
+
+@dataclass
+class _Access:
+  method: str
+  attr: str
+  guarded: bool
+  is_store: bool
+  line: int
+  col: int
+  node: ast.AST = None
+
+
+@dataclass
+class _ClassInfo:
+  name: str
+  lock_attrs: Set[str] = field(default_factory=set)
+  accesses: List[_Access] = field(default_factory=list)
+  #: method -> [(callee, guarded at call site)]
+  calls: Dict[str, List[Tuple[str, bool]]] = field(default_factory=dict)
+  #: methods that manually self.X.acquire() a known lock
+  manual: Set[str] = field(default_factory=set)
+
+
+def _lock_attr_in_with(item: ast.withitem,
+                       lock_attrs: Set[str]) -> bool:
+  """True for ``with self.X:`` / ``with self.a.b._lock:`` guard items."""
+  dotted = Rule.dotted(item.context_expr)
+  if not dotted.startswith('self.'):
+    return False
+  return (dotted[len('self.'):] in lock_attrs
+          or bool(_LOCKISH_NAME.search(dotted.split('.')[-1])))
+
+
+class GuardedByRule(Rule):
+  code = 'GLT002'
+  name = 'guarded-by-violation'
+
+  def check(self, ctx: FileCtx, project: ProjectCtx) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+      if isinstance(node, ast.ClassDef):
+        yield from self._check_class(ctx, node)
+
+  # -- per-class analysis ------------------------------------------------
+
+  def _check_class(self, ctx: FileCtx,
+                   cls: ast.ClassDef) -> Iterator[Finding]:
+    info = _ClassInfo(cls.name)
+    methods = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    # pass 1: find lock attributes (ctor assignment or with-usage)
+    for m in methods:
+      for n in ast.walk(m):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+          fn = Rule.dotted(n.value.func)
+          if fn.split('.')[-1] in _LOCK_CTORS:
+            for t in n.targets:
+              if isinstance(t, ast.Attribute) and \
+                  isinstance(t.value, ast.Name) and t.value.id == 'self':
+                info.lock_attrs.add(t.attr)
+        elif isinstance(n, ast.With):
+          for item in n.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == 'self' and \
+                _LOCKISH_NAME.search(expr.attr):
+              info.lock_attrs.add(expr.attr)
+    if not info.lock_attrs:
+      return
+    # pass 2: record accesses with guarded state
+    for m in methods:
+      self._walk_method(info, m)
+    # lock-owned attrs: stored under guard somewhere
+    owned = {a.attr for a in info.accesses if a.guarded and a.is_store}
+    if not owned:
+      return
+    # fixpoint: helpers whose every call site is guarded are exempt
+    assumed = self._assumed_locked(info)
+    for acc in info.accesses:
+      if acc.attr not in owned or acc.guarded:
+        continue
+      if acc.method in _EXEMPT_METHODS or acc.method in assumed \
+          or acc.method in info.manual:
+        continue
+      yield Finding(
+          rule=self.code, path=ctx.relpath, line=acc.line, col=acc.col,
+          scope=f'{info.name}.{acc.method}',
+          token=acc.attr,
+          message=(f'self.{acc.attr} is '
+                   f'{"written" if acc.is_store else "read"} without '
+                   f'the lock but stored under it elsewhere in '
+                   f'{info.name} (torn-read class: '
+                   'EmbeddingCache.hit_rate, ServingMetrics.qps); '
+                   'take the lock or baseline with a justification'))
+
+  def _walk_method(self, info: _ClassInfo, method: ast.AST) -> None:
+    name = method.name
+    info.calls.setdefault(name, [])
+
+    def walk(node: ast.AST, guarded: bool) -> None:
+      for child in ast.iter_child_nodes(node):
+        child_guarded = guarded
+        if isinstance(child, ast.With):
+          if any(_lock_attr_in_with(i, info.lock_attrs)
+                 for i in child.items):
+            for i in child.items:
+              walk(i, guarded)           # the lock expr itself
+            for stmt in child.body:
+              # walk() classifies CHILDREN of the node it is handed, so
+              # a def directly in the guarded body must get the nested-
+              # closure exemption here — its body runs later, lockless
+              if isinstance(stmt, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                walk(stmt, False)
+              else:
+                walk(stmt, True)
+            continue
+        elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+          # a nested closure does NOT run under the enclosing lock
+          child_guarded = False
+        elif isinstance(child, ast.Attribute) and \
+            isinstance(child.value, ast.Name) and \
+            child.value.id == 'self':
+          attr = child.attr
+          if attr in info.lock_attrs:
+            walk(child, guarded)
+            continue
+          is_store = isinstance(child.ctx, (ast.Store, ast.Del))
+          info.accesses.append(_Access(
+              name, attr, guarded, is_store,
+              child.lineno, child.col_offset, child))
+        if isinstance(child, ast.Call):
+          fn = child.func
+          if isinstance(fn, ast.Attribute):
+            # manual lock protocol: self.X.acquire()
+            if fn.attr == 'acquire' and \
+                isinstance(fn.value, ast.Attribute) and \
+                isinstance(fn.value.value, ast.Name) and \
+                fn.value.value.id == 'self' and \
+                fn.value.attr in info.lock_attrs:
+              info.manual.add(name)
+            # intra-class call: self.m(...)
+            if isinstance(fn.value, ast.Name) and fn.value.id == 'self':
+              info.calls[name].append((fn.attr, child_guarded))
+        walk(child, child_guarded)
+
+    walk(method, False)
+
+  @staticmethod
+  def _assumed_locked(info: _ClassInfo) -> Set[str]:
+    """Methods every one of whose intra-class call sites holds the
+    lock (directly or via an already-assumed-locked caller)."""
+    sites: Dict[str, List[Tuple[str, bool]]] = {}
+    for caller, callees in info.calls.items():
+      for callee, guarded in callees:
+        sites.setdefault(callee, []).append((caller, guarded))
+    assumed: Set[str] = set()
+    changed = True
+    while changed:
+      changed = False
+      for callee, callers in sites.items():
+        if callee in assumed or callee not in info.calls:
+          continue
+        if all(g or c in assumed for c, g in callers):
+          assumed.add(callee)
+          changed = True
+    return assumed

@@ -1,0 +1,114 @@
+"""GLT006 — silent exception swallow inside a thread/background target.
+
+Bug class: a background loop (batcher dispatcher, stream ingest
+applier, health-check prober) wrapping its body in ``except Exception:
+pass`` — the thread keeps running, the failure leaves no trace, and
+the first evidence is a production stall with an empty flight
+recorder. Every handler inside a function used as a ``Thread(target=)``
+/ ``executor.submit`` callee must re-raise, log, or record something
+(any call or state store in the handler counts — precision over
+recall; intent is judged in review, absence of ANY action is judged
+here).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from ..core import FileCtx, Finding, ProjectCtx, Rule
+from ._scopes import scope_of
+
+
+def _thread_targets(tree: ast.AST) -> Set[str]:
+  """Names of functions handed to Thread(target=) / .submit(f) /
+  start_new_thread(f) anywhere in the module."""
+  targets: Set[str] = set()
+
+  def add(node: ast.AST) -> None:
+    if isinstance(node, ast.Name):
+      targets.add(node.id)
+    elif isinstance(node, ast.Attribute):
+      targets.add(node.attr)
+
+  for node in ast.walk(tree):
+    if not isinstance(node, ast.Call):
+      continue
+    fn = Rule.dotted(node.func)
+    last = fn.split('.')[-1]
+    if last == 'Thread':
+      for kw in node.keywords:
+        if kw.arg == 'target':
+          add(kw.value)
+    elif last in ('submit', 'start_new_thread', 'run_in_executor',
+                  'call_soon_threadsafe', 'after_idle'):
+      if node.args:
+        add(node.args[0])
+  return targets
+
+
+def _is_silent(handler: ast.ExceptHandler) -> bool:
+  """No raise, no call, no state store, and the caught exception value
+  is never used anywhere in the handler body."""
+  for n in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+    if isinstance(n, (ast.Raise, ast.Call)):
+      return False
+    if isinstance(n, (ast.Attribute, ast.Subscript)) and \
+        isinstance(n.ctx, ast.Store):
+      return False
+    if handler.name and isinstance(n, ast.Name) and \
+        isinstance(n.ctx, ast.Load) and n.id == handler.name:
+      return False     # `except E as e: item = e` — the value is
+                       # captured for later surfacing, not dropped
+    if isinstance(n, (ast.Continue, ast.Break, ast.Return)) and \
+        handler.type is not None and \
+        _names_only_stop_kinds(handler.type):
+      return False     # except StopIteration/queue.Empty: continue —
+  return True          # control-flow on an expected sentinel, not a swallow
+
+
+def _names_only_stop_kinds(type_expr: ast.AST) -> bool:
+  names = set()
+  for n in ast.walk(type_expr):
+    name = getattr(n, 'attr', None) or getattr(n, 'id', None)
+    if name:
+      names.add(name)
+  sentinels = {'Empty', 'Full', 'StopIteration', 'TimeoutError',
+               'queue', 'asyncio', 'socket', 'timeout'}
+  return bool(names) and names <= sentinels
+
+
+class ThreadExceptRule(Rule):
+  code = 'GLT006'
+  name = 'silent-thread-except'
+
+  def check(self, ctx: FileCtx, project: ProjectCtx) -> Iterator[Finding]:
+    targets = _thread_targets(ctx.tree)
+    if not targets:
+      return
+    for node in ast.walk(ctx.tree):
+      if not (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+              and node.name in targets):
+        continue
+      # exclude ENTIRE nested-def subtrees: a closure defined inside
+      # the target is analyzed on its own if it is itself a target,
+      # and its handlers must not be attributed to the outer function
+      nested = set()
+      for inner in ast.walk(node):
+        if isinstance(inner, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)) and inner is not node:
+          nested.update(id(sub) for sub in ast.walk(inner))
+      for inner in ast.walk(node):
+        if id(inner) in nested:
+          continue
+        if not isinstance(inner, ast.ExceptHandler):
+          continue
+        if _is_silent(inner):
+          kind = ast.unparse(inner.type) if inner.type else 'BaseException'
+          yield Finding(
+              rule=self.code, path=ctx.relpath, line=inner.lineno,
+              col=inner.col_offset, scope=scope_of(ctx.tree, inner),
+              token=f'{node.name}:{kind}',
+              message=(f'except {kind} in thread target '
+                       f'{node.name}() neither re-raises, records to '
+                       'the FlightRecorder, nor logs — a background '
+                       'failure here is invisible until the stall'))
