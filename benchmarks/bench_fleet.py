@@ -1,0 +1,170 @@
+"""Fleet-router scaling benchmark: QPS vs shard count.
+
+Measures the front-door cost of :class:`glt_tpu.serving.FleetRouter`
+(admission, partition-book routing, snapshot-gate read, per-shard
+failover walk, scatter-back) as the fleet widens: the same closed-loop
+client load is replayed against 1..``--max-shards`` local shards.
+Engines run an identity forward over a value-encoded graph, so the
+curve isolates ROUTER overhead + dispatch parallelism from model
+compute — and every response is self-checking (row k == ids[k]).
+
+Prints one JSON line per shard count plus a ``curve`` summary line.
+``GLT_BENCH_HISTORY=<path>`` appends each point to the bench
+trajectory (benchmarks/history.py) under the ``fleet`` bench key,
+``engine=shards<k>`` — rows only ever compare within an exact
+(bench, engine, scale, device) key, so the per-width series gate
+independently.
+
+``GLT_BENCH_PLATFORM=cpu`` forces the CPU backend.
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def ring_dataset(num_nodes: int, feat_dim: int):
+  """Value-encoded ring graph (row i == [i]*dim), the tests' fixture
+  shape rebuilt here so the benchmark has no test-tree import."""
+  from glt_tpu.data import Dataset
+  v = np.arange(num_nodes, dtype=np.int64)
+  rows = np.repeat(v, 2)
+  cols = np.stack([(v + 1) % num_nodes, (v + 2) % num_nodes],
+                  1).reshape(-1)
+  ds = Dataset(edge_dir='out')
+  ds.init_graph(edge_index=np.stack([rows, cols]),
+                edge_ids=np.arange(2 * num_nodes, dtype=np.int64),
+                num_nodes=num_nodes)
+  ds.init_node_features(
+      np.tile(np.arange(num_nodes, dtype=np.float32)[:, None],
+              (1, feat_dim)))
+  return ds
+
+
+def build_router(num_shards, replicas, num_nodes, feat_dim, fanout,
+                 buckets):
+  from glt_tpu.partition.partition_book import RangePartitionBook
+  from glt_tpu.serving import FleetRouter, FleetShard, InferenceEngine
+  shards = []
+  for s in range(num_shards):
+    engines = [
+        InferenceEngine(ring_dataset(num_nodes, feat_dim), None, None,
+                        fanout, buckets=buckets,
+                        apply_fn=lambda p, b: b.x)
+        for _ in range(replicas)]
+    shards.append(FleetShard.local(f'shard{s}', engines))
+  bounds = [num_nodes * (s + 1) // num_shards
+            for s in range(num_shards)]
+  # replicated id space: every shard holds rows for its slice of one
+  # global [0, num_nodes) space — the book is the load-spreading fn
+  return FleetRouter(shards, RangePartitionBook(bounds))
+
+
+def run_load(router, clients, requests, max_request, num_nodes):
+  lat = []
+  lat_lock = threading.Lock()
+  errs = []
+
+  def client(seed):
+    rng = np.random.default_rng(seed)
+    mine = []
+    for _ in range(requests):
+      ids = rng.integers(0, num_nodes, size=rng.integers(
+          1, max_request + 1))
+      t0 = time.perf_counter()
+      try:
+        out = router.infer(ids, timeout_ms=30_000)
+      except Exception as e:
+        errs.append(e)
+        return
+      mine.append(time.perf_counter() - t0)
+      if not np.allclose(out[:, 0], ids):
+        errs.append(AssertionError('routing returned wrong rows'))
+        return
+    with lat_lock:
+      lat.extend(mine)
+
+  threads = [threading.Thread(target=client, args=(s,))
+             for s in range(clients)]
+  t0 = time.perf_counter()
+  for t in threads:
+    t.start()
+  for t in threads:
+    t.join()
+  wall = time.perf_counter() - t0
+  if errs:
+    raise errs[0]
+  lat_ms = np.sort(np.asarray(lat)) * 1e3
+  return {
+      'qps': len(lat) / wall,
+      'latency_p50_ms': float(np.percentile(lat_ms, 50)),
+      'latency_p99_ms': float(np.percentile(lat_ms, 99)),
+      'wall_s': wall,
+      'requests': len(lat),
+  }
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--max-shards', type=int, default=3)
+  ap.add_argument('--replicas', type=int, default=1,
+                  help='replicas per shard (local engines)')
+  ap.add_argument('--num-nodes', type=int, default=6_000)
+  ap.add_argument('--feat-dim', type=int, default=32)
+  ap.add_argument('--fanout', default='4,4')
+  ap.add_argument('--buckets', default='16,64')
+  ap.add_argument('--clients', type=int, default=4)
+  ap.add_argument('--requests', type=int, default=100,
+                  help='requests per client per shard count')
+  ap.add_argument('--max-request', type=int, default=16)
+  args = ap.parse_args()
+
+  from glt_tpu.utils.backend import force_backend
+  force_backend()
+  import jax
+  device = jax.devices()[0].platform
+
+  fanout = [int(x) for x in args.fanout.split(',')]
+  buckets = tuple(int(x) for x in args.buckets.split(','))
+  hist = os.environ.get('GLT_BENCH_HISTORY')
+  scale = f'n{args.num_nodes}xc{args.clients}'
+  curve = {}
+  for k in range(1, args.max_shards + 1):
+    router = build_router(k, args.replicas, args.num_nodes,
+                          args.feat_dim, fanout, buckets)
+    try:
+      # warm every shard's buckets out of the measured window (ids
+      # spread over the full space so no shard cold-compiles mid-load)
+      warm = np.linspace(0, args.num_nodes - 1, num=16 * k,
+                         dtype=np.int64)
+      for b in buckets:
+        router.infer(warm[:min(b, warm.size)])
+        router.infer(warm)
+      res = run_load(router, args.clients, args.requests,
+                     args.max_request, args.num_nodes)
+    finally:
+      router.close()
+    row = {'bench': 'fleet', 'shards': k, 'replicas': args.replicas,
+           'device': device, 'scale': scale, **res}
+    print(json.dumps(row, sort_keys=True))
+    curve[k] = round(res['qps'], 1)
+    if hist:
+      from benchmarks.history import append_run
+      append_run(hist, bench='fleet', value=res['qps'], unit='qps',
+                 engine=f'shards{k}', scale=scale, device=device,
+                 latency_p50_ms=round(res['latency_p50_ms'], 3),
+                 latency_p99_ms=round(res['latency_p99_ms'], 3))
+  print(json.dumps({'bench': 'fleet', 'curve_qps_by_shards': curve,
+                    'device': device, 'scale': scale},
+                   sort_keys=True))
+
+
+if __name__ == '__main__':
+  main()

@@ -78,7 +78,14 @@ def init_client(num_servers: int, num_clients: int, client_rank: int,
         retry=retry,
         breaker=CircuitBreaker(failure_threshold=breaker_threshold,
                                reset_timeout_s=breaker_reset_s,
-                               name=f'server:{s}'),
+                               name=f'server:{s}',
+                               registry=registry),
+        # apply_delta is MUTATING but safe to retry WITH a request id:
+        # the server-side dedup LRU replays the recorded reply on a
+        # lost-reply retry instead of staging the delta cut twice
+        # (rpc.IDEMPOTENT_CALLEES deliberately excludes it, so opt in
+        # per-client here where every callee is a DistServer)
+        idempotent=frozenset({'apply_delta'}),
         metrics=_metrics)
 
   def probe(rank):
@@ -245,7 +252,14 @@ def apply_delta(server_rank: int, ins=None, dels=None, feat_ids=None,
   """Post live graph/feature updates to one partition server (its
   ``DistServer.apply_delta``). ``ins``/``dels`` are [2, n] edge blocks
   in that partition's local ids; ``compact=True`` forces the server to
-  fold the delta into a fresh snapshot immediately."""
+  fold the delta into a fresh snapshot immediately.
+
+  Exactly-once-observable: ``init_client`` marks ``apply_delta``
+  idempotent on every per-server RpcClient, so the request carries a
+  request id and a retry after a lost reply gets the server's RECORDED
+  reply from its dedup LRU — the delta cut is never staged twice (a
+  double-stage would double-insert edges and double-bump the snapshot
+  version)."""
   from ..channel import pack_message
   msg = {}
   if ins is not None:

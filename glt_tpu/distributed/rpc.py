@@ -33,9 +33,14 @@ _HDR = struct.Struct('<Q')
 #: Callees safe to retry after a lost reply (read-only, or — like
 #: fetch_one_sampled_message — made retry-safe by the server's
 #: request-id dedup cache, which replays the original reply instead of
-#: re-executing a pop). Mutating callees (apply_delta, exit, barriers)
-#: are deliberately absent: they get transparent reconnect but never an
+#: re-executing a pop). Mutating callees (exit, barriers) are
+#: deliberately absent: they get transparent reconnect but never an
 #: automatic re-send after the request may have been delivered.
+#: ``apply_delta`` is also absent HERE, but clients whose every callee
+#: is a delta-staging server (dist_client.init_client, the fleet
+#: router's remote replicas) opt it in via ``idempotent=`` — the same
+#: req-id dedup replay makes the mutation exactly-once-observable, so
+#: a lost-reply retry can never double-stage a delta cut.
 IDEMPOTENT_CALLEES: FrozenSet[str] = frozenset({
     'get_node_feature', 'get_node_label', 'get_dataset_meta',
     'get_tensor_size', 'get_edge_index', 'get_edge_size',

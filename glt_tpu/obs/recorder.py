@@ -313,6 +313,14 @@ class SloBurnEvaluator:
     return self
 
   def evaluate(self) -> Dict[str, float]:
+    return {name: rec['burn']
+            for name, rec in self.evaluate_detailed().items()}
+
+  def evaluate_detailed(self) -> Dict[str, dict]:
+    """Like :meth:`evaluate` but returns
+    ``{name: {'burn': float, 'window': int}}`` — the window request
+    count lets callers (the fleet scale-signal loop) suppress
+    decisions over windows too thin to mean anything."""
     reg = self._registry if self._registry is not None \
         else get_registry()
     out = {}
@@ -329,7 +337,7 @@ class SloBurnEvaluator:
         self._last[p.name] = (count, above)
       burn = (d_above / d_count) / p.error_budget if d_count > 0 \
           else 0.0
-      out[p.name] = burn
+      out[p.name] = {'burn': burn, 'window': int(d_count)}
       # the policy's labels ride the gauge too: two shards sharing one
       # registry (distinct view= labels) publish distinct burn series
       # instead of clobbering each other

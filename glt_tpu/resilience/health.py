@@ -46,17 +46,28 @@ class HealthMonitor:
     degraded_after / down_after: consecutive-failure thresholds.
     on_change: ``fn(name, old_status, new_status)`` called outside the
       lock on every transition (metrics / logging hook).
+    labels: extra series labels (e.g. ``{'shard': 'shard1'}``) riding
+      every published ``health_status`` point, so two shards' monitors
+      on one shared registry never merge series (target names alone
+      collide: every shard calls its replicas ``r0``/``r1``).
+    registry: optional MetricsRegistry; when set, every transition
+      publishes a labeled ``health_status`` gauge
+      (0=UP, 1=DEGRADED, 2=DOWN) per target.
   """
 
   def __init__(self, probes: Dict[object, Callable[[], object]],
                interval_s: float = 1.0, degraded_after: int = 1,
                down_after: int = 3,
-               on_change: Optional[Callable] = None):
+               on_change: Optional[Callable] = None,
+               labels: Optional[Dict[str, str]] = None,
+               registry=None):
     assert 1 <= degraded_after <= down_after
     self.interval_s = float(interval_s)
     self.degraded_after = int(degraded_after)
     self.down_after = int(down_after)
     self.on_change = on_change
+    self.labels = {str(k): str(v) for k, v in (labels or {}).items()}
+    self.registry = registry
     self._probes = dict(probes)
     self._lock = threading.Lock()
     self._cond = threading.Condition(self._lock)
@@ -130,6 +141,13 @@ class HealthMonitor:
     self._cond.notify_all()
     if new != old:
       logger.warning('health: %s %s -> %s', name, old, new)
+      if self.registry is not None:
+        try:  # registry has its own lock and never re-enters ours
+          self.registry.set('health_status',
+                            {UP: 0.0, DEGRADED: 1.0, DOWN: 2.0}[new],
+                            target=str(name), **self.labels)
+        except Exception:
+          pass
       if self.on_change is not None:
         cb = self.on_change
         # fire outside the lock: a callback that re-enters status()

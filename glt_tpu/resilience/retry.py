@@ -19,7 +19,7 @@ import dataclasses
 import random
 import threading
 import time
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
 
 #: breaker states (the classic 3-state machine)
 CLOSED = 'CLOSED'
@@ -88,17 +88,30 @@ class CircuitBreaker:
   breaker opening is exactly the moment a postmortem wants the recent
   span/counter context captured. ``name`` labels the peer in that
   event (optional, purely observational).
+
+  ``labels`` (e.g. ``{'shard': 'shard0', 'replica': 'r1'}``) ride
+  every trip payload and every registry series, so two shards sharing
+  one registry never merge their breaker series — the fleet lesson:
+  an unlabeled ``breaker_opens_total`` summed across shards cannot
+  tell "shard 2 is dying" from "everything is mildly flaky". With
+  ``registry=`` set, the breaker also publishes a labeled
+  ``breaker_state`` gauge (0=CLOSED, 1=HALF_OPEN, 2=OPEN) and a
+  ``breaker_opens_total`` counter on every transition.
   """
 
   def __init__(self, failure_threshold: int = 5,
                reset_timeout_s: float = 5.0,
                on_open: Optional[Callable[[], None]] = None,
-               name: str = ''):
+               name: str = '',
+               labels: Optional[Dict[str, str]] = None,
+               registry=None):
     assert failure_threshold >= 1
     self.failure_threshold = int(failure_threshold)
     self.reset_timeout_s = float(reset_timeout_s)
     self.on_open = on_open
     self.name = str(name)
+    self.labels = {str(k): str(v) for k, v in (labels or {}).items()}
+    self.registry = registry
     self._lock = threading.Lock()
     self._state = CLOSED
     self._consecutive_failures = 0
@@ -129,11 +142,29 @@ class CircuitBreaker:
         return True
       return False
 
+  def _series_labels(self) -> Dict[str, str]:
+    out = dict(self.labels)
+    if self.name:
+      out.setdefault('breaker', self.name)
+    return out
+
+  def _publish_state(self, state: str) -> None:
+    """Labeled ``breaker_state`` gauge (0/1/2) — best-effort, outside
+    the lock; metrics must never wedge the failure path."""
+    if self.registry is None:
+      return
+    try:
+      code = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}[state]
+      self.registry.set('breaker_state', code, **self._series_labels())
+    except Exception:
+      pass
+
   def record_success(self) -> None:
     with self._lock:
       self._state = CLOSED
       self._consecutive_failures = 0
       self._probe_inflight = False
+    self._publish_state(CLOSED)
 
   def record_failure(self) -> None:
     fire = False
@@ -156,6 +187,13 @@ class CircuitBreaker:
       # would otherwise record consecutive_failures=0 for an OPEN
       failures, opens = self._consecutive_failures, self.opens
     if fire:
+      self._publish_state(OPEN)
+      if self.registry is not None:
+        try:
+          self.registry.inc('breaker_opens_total',
+                            **self._series_labels())
+        except Exception:
+          pass
       if self.on_open is not None:
         try:
           self.on_open()
@@ -163,9 +201,10 @@ class CircuitBreaker:
           pass
       try:  # postmortem hook — must never break the failure path
         from ..obs.recorder import get_recorder
-        get_recorder().trip(
-            'breaker_open', breaker=self.name,
-            consecutive_failures=failures, opens=opens)
+        payload = dict(self.labels)
+        payload.update(breaker=self.name,
+                       consecutive_failures=failures, opens=opens)
+        get_recorder().trip('breaker_open', **payload)
       except Exception:
         pass
 

@@ -17,6 +17,10 @@ from .batcher import (  # noqa: F401
 )
 from .embedding_cache import EmbeddingCache  # noqa: F401
 from .engine import InferenceEngine  # noqa: F401
+from .fleet import (  # noqa: F401
+    AdmissionClass, AdmissionController, FleetOverloaded, FleetRouter,
+    FleetShard, FleetUnavailable, ScalePolicy,
+)
 from .metrics import LatencyHistogram, ServingMetrics  # noqa: F401
 from .server import ServingClient, ServingServer  # noqa: F401
 
@@ -25,4 +29,6 @@ __all__ = [
     'EmbeddingCache',
     'InferenceEngine', 'LatencyHistogram', 'ServingMetrics',
     'ServingClient', 'ServingServer',
+    'AdmissionClass', 'AdmissionController', 'FleetOverloaded',
+    'FleetRouter', 'FleetShard', 'FleetUnavailable', 'ScalePolicy',
 ]

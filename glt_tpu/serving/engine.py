@@ -119,6 +119,7 @@ class InferenceEngine:
     self.forward_calls = 0    # executed bucket runs (not traces)
     self._out_dim: Optional[int] = None
     self._warmed = False
+    self._snapshot_version = 0
     self._lock = threading.Lock()
 
   # -- compilation -------------------------------------------------------
@@ -356,8 +357,19 @@ class InferenceEngine:
     across all versions."""
     return self.invalidate(ids=ids)
 
+  @property
+  def snapshot_version(self) -> int:
+    """The stream-snapshot version this engine last swapped onto (0 =
+    the construction-time graph). Read under the engine lock so a
+    caller never observes the version of a swap whose invalidation has
+    not landed yet — the consistency token the fleet router threads
+    through `apply_delta` propagation."""
+    with self._lock:
+      return self._snapshot_version
+
   def update_snapshot(self, snapshot, touched_ids=None,
-                      expand_in_neighbors: bool = False) -> int:
+                      expand_in_neighbors: bool = False,
+                      version: Optional[int] = None) -> int:
     """Swap serving onto a new stream snapshot (glt_tpu.stream).
 
     Under the engine lock (serialized against in-flight infer): install
@@ -377,6 +389,11 @@ class InferenceEngine:
         1-hop neighborhood of the touched ids (``Snapshot.
         expand_affected`` via the CSC view for a CSR base) — the nodes
         whose cached embeddings *aggregate over* a touched node.
+      version: the snapshot's version token (``Snapshot.version`` /
+        the ingestor flush info ``'version'``); None auto-increments.
+        Stamped in the SAME lock hold as the swap+invalidation, so
+        :attr:`snapshot_version` == v implies version-v features are
+        installed AND every pre-v cached row of a touched id is gone.
 
     Returns the number of cache entries dropped.
     """
@@ -392,6 +409,8 @@ class InferenceEngine:
     with self._lock:
       if snapshot.feature is not None:
         self.data.node_features = snapshot.feature
+      self._snapshot_version = int(version) if version is not None \
+          else self._snapshot_version + 1
       if touched_ids is None:
         return self.cache.invalidate()
       ids = as_numpy(touched_ids).astype(np.int64).reshape(-1)

@@ -12,6 +12,8 @@ Registered callees:
   * ``stats()``                     -> metrics + cache + compile stats
   * ``invalidate(ids=None, version=None)`` -> entries dropped
   * ``ping()``                      -> server identity / readiness
+  * ``apply_delta(...)``            -> stage + fold live updates into
+    the server's stream ingestor (only when built with ``stream=``)
 """
 from __future__ import annotations
 
@@ -51,6 +53,13 @@ class ServingServer:
       payload. None reads the ``GLT_OBS_SLO`` knob; policies without
       an explicit metric label default onto THIS server's
       ``serving_latency_seconds`` series.
+    stream: optional :class:`glt_tpu.stream.StreamIngestor` (built by
+      the caller with this server's engine + sampler); registers the
+      ``apply_delta`` callee so a fleet router can propagate live
+      graph/feature updates to remote replicas. Callers retrying
+      apply_delta MUST mark it idempotent on their RpcClient (the
+      ServingClient here does) — the req-id dedup replay is what makes
+      a retried mutation exactly-once-observable.
   """
 
   def __init__(self, engine: InferenceEngine, host: str = '127.0.0.1',
@@ -61,8 +70,9 @@ class ServingServer:
                stall_timeout_ms: Optional[float] = None,
                stale_serve: bool = False,
                registry=None, metrics_name: str = '',
-               slos=None):
+               slos=None, stream=None):
     self.engine = engine
+    self.stream = stream
     self.stale_serve = bool(stale_serve)
     if warmup:
       engine.warmup()
@@ -114,6 +124,7 @@ class ServingServer:
     self.rpc.register('stats', self.stats)
     self.rpc.register('invalidate', self.invalidate)
     self.rpc.register('ping', self._ping)
+    self.rpc.register('apply_delta', self.apply_delta)
     self.rpc.start()
 
   @property
@@ -172,10 +183,46 @@ class ServingServer:
     # through the engine: serialized against in-flight infer
     return self.engine.invalidate(ids=ids, version=version)
 
+  def apply_delta(self, ins=None, dels=None, feat_ids=None,
+                  feat_rows=None, compact: bool = True) -> dict:
+    """Stage live updates into this replica's stream ingestor and (by
+    default) fold them immediately: compaction -> RCU snapshot swap ->
+    engine ``update_snapshot`` cache invalidation, returning the
+    snapshot version now being served — the consistency token the
+    fleet router compares across shards. ``ins``/``dels`` are [2, n]
+    edge blocks in this server's id space."""
+    if self.stream is None:
+      raise RuntimeError(
+          'this server has no stream ingestor: build the ServingServer '
+          'with stream= (a StreamIngestor over its engine) to accept '
+          'apply_delta')
+    staged = 0
+    if ins is not None:
+      ins = np.asarray(ins, np.int64).reshape(2, -1)
+      if ins.shape[1]:
+        staged += self.stream.insert_edges(ins[0], ins[1])
+    if dels is not None:
+      dels = np.asarray(dels, np.int64).reshape(2, -1)
+      if dels.shape[1]:
+        staged += self.stream.delete_edges(dels[0], dels[1])
+    if feat_ids is not None:
+      feat_ids = np.asarray(feat_ids, np.int64).reshape(-1)
+      if feat_ids.size:
+        staged += self.stream.update_features(
+            feat_ids, np.asarray(feat_rows))
+    info = self.stream.flush() if compact \
+        else self.stream.maybe_compact()
+    return {'staged': int(staged),
+            'compacted': info is not None,
+            'invalidated': int(info.get('invalidated', 0)) if info
+            else 0,
+            'version': int(self.engine.snapshot_version)}
+
   def _ping(self) -> dict:
     return {'ok': True, 'buckets': list(self.engine.buckets),
             'output_dim': self.engine.output_dim,
-            'model_version': self.engine.model_version}
+            'model_version': self.engine.model_version,
+            'snapshot_version': self.engine.snapshot_version}
 
   def close(self) -> None:
     self.batcher.stop()
@@ -192,7 +239,11 @@ class ServingClient:
   """Thin client over the rpc fabric's RpcClient."""
 
   def __init__(self, host: str, port: int, timeout: float = 180.0):
-    self._rpc = RpcClient(host, port, timeout=timeout)
+    # apply_delta is mutating-but-dedupable: with the request id
+    # attached, a lost-reply retry replays the server's recorded reply
+    # instead of staging the delta twice (rpc.IDEMPOTENT_CALLEES)
+    self._rpc = RpcClient(host, port, timeout=timeout,
+                          idempotent=frozenset({'apply_delta'}))
 
   def infer(self, ids, timeout_ms: Optional[float] = None) -> np.ndarray:
     # the client-supplied deadline ALSO bounds the rpc wait (plus small
@@ -219,6 +270,12 @@ class ServingClient:
 
   def invalidate(self, ids=None, version=None) -> int:
     return self._rpc.request('invalidate', ids=ids, version=version)
+
+  def apply_delta(self, ins=None, dels=None, feat_ids=None,
+                  feat_rows=None, compact: bool = True) -> dict:
+    return self._rpc.request(
+        'apply_delta', ins=ins, dels=dels, feat_ids=feat_ids,
+        feat_rows=feat_rows, compact=compact)
 
   def ping(self) -> dict:
     return self._rpc.request('ping')

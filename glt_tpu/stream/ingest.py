@@ -247,9 +247,13 @@ class StreamIngestor:
       if self.sampler is not None:
         self.sampler.refresh_overlay(self.edges)
       if self.engine is not None:
+        # stamp the manager's version as the engine's snapshot_version:
+        # the fleet consistency token compares engine versions across
+        # shards, so they must share the snapshot chain's numbering
         info['invalidated'] = self.engine.update_snapshot(
             snap, touched_ids=info['touched'],
-            expand_in_neighbors=self.expand_invalidation)
+            expand_in_neighbors=self.expand_invalidation,
+            version=info.get('version'))
       self._last_compaction_ts = time.monotonic()
       info['wall_s'] = t.stop()
       if info['capacity_grown']:

@@ -453,3 +453,54 @@ def test_chaos_schedule_is_deterministic_across_runs():
   fa, fb = a.fork(9), b.fork(9)  # ONE fork each: compare whole streams
   assert [fa.next_fault() for _ in range(100)] \
       == [fb.next_fault() for _ in range(100)]
+
+
+def test_apply_delta_retry_never_double_stages():
+  """Satellite of the fleet PR: ``apply_delta`` is mutating-but-
+  dedupable. A client that marks it idempotent attaches a request id,
+  so when chaos eats only the REPLY the retry replays the server's
+  recorded answer instead of staging (and compacting) the delta cut a
+  second time — staging twice would double-insert the same edges."""
+  from glt_tpu.distributed.rpc import RpcClient, RpcServer
+  from glt_tpu.resilience import (
+      ChaosTcpProxy, CircuitBreaker, FaultPlan, RetryPolicy,
+  )
+  srv = RpcServer()
+  stages = {}
+  lock = threading.Lock()
+
+  def apply_delta(cut):
+    with lock:
+      stages[cut] = stages.get(cut, 0) + 1
+      version = len(stages)
+    return {'version': version, 'staged': 1}
+
+  srv.register('apply_delta', apply_delta)
+  plan = FaultPlan(seed=1234, drop=0.2, disconnect=0.1, delay=0.1,
+                   delay_s=0.01)
+  proxy = ChaosTcpProxy(srv.host, srv.port, plan)
+  # the same client shape the fleet's remote replicas and the
+  # dist_client build: apply_delta opted into the req-id dedup
+  cli = RpcClient(
+      *proxy.address, timeout=10,
+      retry=RetryPolicy(max_attempts=8, base_delay_s=0.01,
+                        max_delay_s=0.05, jitter=0),
+      breaker=CircuitBreaker(failure_threshold=1000),
+      idempotent=frozenset({'apply_delta'}))
+  try:
+    versions = []
+    for cut in range(40):
+      out = cli.request('apply_delta', cut, _rpc_timeout=5.0)
+      versions.append(out['version'])
+    assert cli.retries > 0, 'chaos schedule injected no faults?'
+    assert sum(proxy.faults_injected.values()) > 0
+    multi = {k: v for k, v in stages.items() if v != 1}
+    assert not multi, f'delta cut staged more than once: {multi}'
+    assert len(stages) == 40
+    # replayed replies are the RECORDED ones: the version sequence the
+    # client observed is exactly the server's staging order
+    assert versions == list(range(1, 41))
+  finally:
+    cli.close()
+    proxy.close()
+    srv.stop()

@@ -293,3 +293,79 @@ def test_metrics_counters_torn_read_safe():
   assert not bad
   s = m.snapshot()
   assert s['retries'] == s['shed'] == s['stale_serves'] == N * W
+
+
+# -- shard/replica-labeled resilience series (fleet contract) ------------
+
+def test_breaker_series_and_trip_payload_carry_labels():
+  """Two shards' breakers on ONE shared registry: the ``labels=`` keys
+  must ride both the published series (``breaker_state`` /
+  ``breaker_opens_total``) and the FlightRecorder trip payload, so a
+  fleet postmortem can tell WHICH replica opened — unlabeled series
+  from shard0/r0 and shard1/r0 would silently merge."""
+  from glt_tpu.obs.recorder import FlightRecorder, set_recorder
+  from glt_tpu.obs.registry import MetricsRegistry
+
+  reg = MetricsRegistry()
+  rec = FlightRecorder()
+  prev = set_recorder(rec)
+  try:
+    breakers = {
+        s: CircuitBreaker(failure_threshold=2, reset_timeout_s=60,
+                          name=f'{s}/r0',
+                          labels={'shard': s, 'replica': 'r0'},
+                          registry=reg)
+        for s in ('s0', 's1')}
+    breakers['s0'].record_failure()
+    breakers['s0'].record_failure()   # s0/r0 opens
+    assert breakers['s0'].state == OPEN
+    assert reg.get('breaker_state', breaker='s0/r0', shard='s0',
+                   replica='r0') == 2.0
+    assert reg.get('breaker_opens_total', breaker='s0/r0', shard='s0',
+                   replica='r0') == 1
+    # s1/r0 shares the registry but NOT the series
+    assert reg.get('breaker_opens_total', breaker='s1/r0', shard='s1',
+                   replica='r0') == 0
+    breakers['s1'].record_failure()
+    assert reg.get('breaker_state', breaker='s1/r0', shard='s1',
+                   replica='r0') == 0.0  # still CLOSED
+    trips = [e for e in rec.events() if e['kind'] == 'breaker_open']
+    assert len(trips) == 1
+    assert trips[0]['shard'] == 's0'
+    assert trips[0]['replica'] == 'r0'
+    assert trips[0]['breaker'] == 's0/r0'
+  finally:
+    set_recorder(prev)
+
+
+def test_breaker_close_publishes_closed_state():
+  from glt_tpu.obs.registry import MetricsRegistry
+  reg = MetricsRegistry()
+  b = CircuitBreaker(failure_threshold=1, reset_timeout_s=0.05,
+                     name='s0/r0', labels={'shard': 's0'}, registry=reg)
+  b.record_failure()
+  assert reg.get('breaker_state', breaker='s0/r0', shard='s0') == 2.0
+  time.sleep(0.06)
+  assert b.allow()
+  b.record_success()
+  assert reg.get('breaker_state', breaker='s0/r0', shard='s0') == 0.0
+
+
+def test_health_monitor_publishes_labeled_status_series():
+  """Two monitors with colliding target names (every shard calls its
+  replicas r0/r1) stay distinct series via ``labels=``."""
+  from glt_tpu.obs.registry import MetricsRegistry
+  reg = MetricsRegistry()
+  mons = {
+      s: HealthMonitor({'r0': lambda: True}, degraded_after=1,
+                       down_after=2, labels={'shard': s}, registry=reg)
+      for s in ('s0', 's1')}
+  mons['s0'].record_failure('r0')
+  mons['s0'].record_failure('r0')
+  assert mons['s0'].status('r0') == DOWN
+  assert reg.get('health_status', target='r0', shard='s0') == 2.0
+  # shard1's r0 is untouched: no publication, default reads 0
+  assert mons['s1'].status('r0') == UP
+  assert reg.get('health_status', target='r0', shard='s1') == 0.0
+  mons['s0'].record_success('r0')
+  assert reg.get('health_status', target='r0', shard='s0') == 0.0

@@ -490,3 +490,58 @@ def test_stale_serve_disabled_fails_fast(model_and_params):
     gate.set()
   finally:
     srv.close()
+
+
+def test_update_snapshot_never_serves_mixed_versions():
+  """Versioned-consistency regression: while ``update_snapshot`` swaps
+  the feature table under the engine lock, a concurrent ``infer`` must
+  observe EITHER the old table end-to-end OR the new one — never
+  snapshot-v rows for some ids and v-1 rows for others in one response.
+  Rows value-encode their version (1000*v + id) so a torn response is
+  directly visible in the output."""
+  from glt_tpu.stream import SnapshotManager, StreamIngestor, StreamSampler
+
+  dim, n = 8, 40
+  ds = ring_dataset(num_nodes=n, feat_dim=dim)
+  mgr = SnapshotManager(ds.get_graph().topo, ds.get_node_feature())
+  eng = InferenceEngine(ds, None, None, [2], buckets=(8,),
+                        apply_fn=lambda p, b: b.x,
+                        sampler=StreamSampler(mgr, [2], seed=0))
+  ing = StreamIngestor(mgr, sampler=eng.sampler, engine=eng)
+  ids = np.array([2, 7, 13, 22, 29, 37])
+  errs, seen = [], set()
+  stop = threading.Event()
+
+  def hammer():
+    try:
+      while not stop.is_set():
+        before = eng.snapshot_version
+        out = eng.infer(ids)
+        marks = np.unique(out[:, 0] - ids)  # 1000*v per row
+        assert marks.size == 1, f'mixed versions in one infer: {marks}'
+        v = int(marks[0]) // 1000
+        # monotone: an infer that started at snapshot ``before`` may
+        # observe a newer table, never an older one
+        assert v >= before, (v, before)
+        seen.add(v)
+    except Exception as e:
+      errs.append(e)
+
+  threads = [threading.Thread(target=hammer) for _ in range(3)]
+  try:
+    for t in threads:
+      t.start()
+    for v in range(1, 4):
+      rows = 1000.0 * v + np.arange(n, dtype=np.float32)[:, None] \
+          * np.ones(dim, np.float32)
+      ing.update_features(np.arange(n), rows)
+      info = ing.flush()
+      assert info['version'] == v
+      assert eng.snapshot_version == v
+      time.sleep(0.05)
+  finally:
+    stop.set()
+    for t in threads:
+      t.join(timeout=10)
+  assert not errs, errs
+  assert 3 in seen, f'final snapshot never observed: {sorted(seen)}'
